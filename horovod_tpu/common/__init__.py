@@ -1,0 +1,22 @@
+"""Common core types shared by every layer of horovod_tpu.
+
+Python-visible mirror of the native core's type system (see ``csrc/``).
+Behavioral parity target: the reference's ``horovod/common/common.h`` and
+``horovod/common/message.h`` (DataType enum at message.h:27-38, Request at
+message.h:47-100, Response at message.h:132-192) — re-designed, not copied:
+the wire format here is a compact little-endian struct encoding rather than
+FlatBuffers, and device identity is a JAX platform string rather than a CUDA
+device ordinal.
+"""
+
+from horovod_tpu.common.types import (  # noqa: F401
+    DataType,
+    ReduceOp,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+    Status,
+    StatusType,
+    TensorShape,
+)

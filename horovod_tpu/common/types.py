@@ -1,0 +1,236 @@
+"""Core message / status / shape types for the coordination plane.
+
+Parity reference (behavior only): ``horovod/common/message.h:27-192`` and
+``horovod/common/common.h:140-200`` in the reference tree. The reference
+serializes with FlatBuffers; we use a self-describing little-endian binary
+encoding (see ``wire.py``) because the controller messages are tiny (tens of
+bytes) and a hand-rolled codec removes the flatc build dependency while
+keeping the C++ core and Python in lockstep via a shared layout spec.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype tags.  Mirrors the *set* of types the reference negotiates
+    (message.h:27-38) plus bfloat16, which is the native TPU accumulation
+    format and therefore first-class here."""
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    BFLOAT16 = 10
+
+    @property
+    def itemsize(self) -> int:
+        return _ITEMSIZE[self]
+
+
+_ITEMSIZE = {
+    DataType.UINT8: 1,
+    DataType.INT8: 1,
+    DataType.UINT16: 2,
+    DataType.INT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.FLOAT16: 2,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.BOOL: 1,
+    DataType.BFLOAT16: 2,
+}
+
+_NUMPY_NAMES = {
+    DataType.UINT8: "uint8",
+    DataType.INT8: "int8",
+    DataType.UINT16: "uint16",
+    DataType.INT16: "int16",
+    DataType.INT32: "int32",
+    DataType.INT64: "int64",
+    DataType.FLOAT16: "float16",
+    DataType.FLOAT32: "float32",
+    DataType.FLOAT64: "float64",
+    DataType.BOOL: "bool",
+    DataType.BFLOAT16: "bfloat16",
+}
+
+
+def dtype_from_numpy(np_dtype) -> DataType:
+    name = str(np_dtype)
+    for k, v in _NUMPY_NAMES.items():
+        if v == name:
+            return k
+    raise ValueError(f"horovod_tpu does not support dtype {name!r}")
+
+
+def dtype_to_numpy_name(dt: DataType) -> str:
+    return _NUMPY_NAMES[dt]
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction semantics carried in the request.
+
+    Average / Sum / Adasum / Min / Max / Product.  The reference exposes
+    Average, Sum, Adasum (``horovod/common/operations.cc`` C API constants,
+    surfaced via basics.py:29-31); the extra lattice ops are free on the
+    XLA path so we expose them too.
+    """
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+class RequestType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ALLTOALL = 4
+    BARRIER = 5
+    REDUCESCATTER = 6
+
+
+class ResponseType(enum.IntEnum):
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ALLTOALL = 4
+    BARRIER = 5
+    REDUCESCATTER = 6
+    ERROR = 7
+
+
+class StatusType(enum.IntEnum):
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass
+class Status:
+    """Operation outcome delivered to completion callbacks.
+
+    Parity: ``horovod/common/common.h:90-138`` (Status with OK / Aborted /
+    PreconditionError / InvalidArgument constructors)."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def ok() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def aborted(reason: str) -> "Status":
+        return Status(StatusType.ABORTED, reason)
+
+    @staticmethod
+    def precondition_error(reason: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, reason)
+
+    @staticmethod
+    def invalid_argument(reason: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, reason)
+
+    @staticmethod
+    def unknown_error(reason: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, reason)
+
+    @staticmethod
+    def in_progress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    def ok_(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress_(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+@dataclass(frozen=True)
+class TensorShape:
+    """Immutable shape; parity: common.h TensorShape (dims + num_elements)."""
+
+    dims: tuple
+
+    def __init__(self, dims: Sequence[int] = ()):  # allow TensorShape([2,3])
+        object.__setattr__(self, "dims", tuple(int(d) for d in dims))
+
+    @property
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def __str__(self) -> str:
+        return "[" + ", ".join(str(d) for d in self.dims) + "]"
+
+
+@dataclass
+class Request:
+    """What one rank wants to do with one named tensor.
+
+    Parity: message.h:47-100 (request_rank, request_type, tensor_type,
+    tensor_name, root_rank, device, tensor_shape) with `prescale_factor` /
+    `postscale_factor` from the v2 torch path folded in, since the XLA
+    backend applies them inside the fused reduction.
+    """
+
+    request_rank: int = 0
+    request_type: RequestType = RequestType.ALLREDUCE
+    tensor_type: DataType = DataType.FLOAT32
+    tensor_name: str = ""
+    root_rank: int = -1
+    device: str = "cpu"
+    tensor_shape: TensorShape = field(default_factory=TensorShape)
+    reduce_op: ReduceOp = ReduceOp.SUM
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+
+@dataclass
+class Response:
+    """What every rank must now execute, in identical order.
+
+    Parity: message.h:132-192 (response_type, tensor_names, error_message,
+    devices, tensor_sizes).  ``tensor_names`` > 1 means the entries were
+    fused into one collective launch."""
+
+    response_type: ResponseType = ResponseType.ERROR
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+    devices: List[str] = field(default_factory=list)
+    # Dtype of the (fused) entries; lets a Joined rank allocate zero
+    # stand-ins from the response alone (parity: tensor_queue.cc:97-113).
+    tensor_type: DataType = DataType.FLOAT32
+    # For allgather: first-dimension sizes gathered from every rank, ordered
+    # by rank, one block per tensor.  For allreduce: total byte size of each
+    # fused tensor (used to slice the fusion buffer).
+    tensor_sizes: List[int] = field(default_factory=list)
+
+    def add_tensor_name(self, name: str) -> None:
+        self.tensor_names.append(name)

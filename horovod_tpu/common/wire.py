@@ -1,0 +1,177 @@
+"""Binary wire codec for controller messages.
+
+Role parity: ``horovod/common/wire/message.fbs`` + ``message.cc`` (the
+reference serializes Request/Response lists with FlatBuffers).  We use a
+hand-rolled little-endian encoding instead: messages are tens of bytes, the
+schema is stable, and one codec spec shared by this file and the C++ core
+(``csrc/wire.h``) avoids a flatc build step.  THE TWO MUST MATCH — any
+change here must be mirrored in csrc/wire.h.
+
+Layout (all integers little-endian):
+
+  varstr   := u32 len, bytes
+  Request  := u8 request_type, i32 request_rank, u8 tensor_type,
+              varstr tensor_name, i32 root_rank, varstr device,
+              u8 reduce_op, f64 prescale, f64 postscale,
+              u8 ndim, i64 dims[ndim]
+  RequestList  := u8 shutdown, u32 n, Request[n]
+  Response := u8 response_type, u8 tensor_type, u32 n_names,
+              varstr[n_names], varstr error_message,
+              u32 n_devices, varstr[n_devices],
+              u32 n_sizes, i64 sizes[n_sizes]
+  ResponseList := u8 shutdown, u32 n, Response[n]
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+from horovod_tpu.common.types import (
+    DataType,
+    ReduceOp,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+    TensorShape,
+)
+
+
+def _pack_str(buf: bytearray, s: str) -> None:
+    b = s.encode("utf-8")
+    buf += struct.pack("<I", len(b))
+    buf += b
+
+
+def _unpack_str(data: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    s = data[off:off + n].decode("utf-8")
+    return s, off + n
+
+
+def encode_request(req: Request, buf: bytearray) -> None:
+    buf += struct.pack("<BiB", int(req.request_type), req.request_rank,
+                       int(req.tensor_type))
+    _pack_str(buf, req.tensor_name)
+    buf += struct.pack("<i", req.root_rank)
+    _pack_str(buf, req.device)
+    buf += struct.pack("<Bdd", int(req.reduce_op), req.prescale_factor,
+                       req.postscale_factor)
+    dims = req.tensor_shape.dims
+    buf += struct.pack("<B", len(dims))
+    for d in dims:
+        buf += struct.pack("<q", d)
+
+
+def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
+    rtype, rrank, ttype = struct.unpack_from("<BiB", data, off)
+    off += struct.calcsize("<BiB")
+    name, off = _unpack_str(data, off)
+    (root,) = struct.unpack_from("<i", data, off)
+    off += 4
+    device, off = _unpack_str(data, off)
+    rop, pre, post = struct.unpack_from("<Bdd", data, off)
+    off += struct.calcsize("<Bdd")
+    (ndim,) = struct.unpack_from("<B", data, off)
+    off += 1
+    dims = []
+    for _ in range(ndim):
+        (d,) = struct.unpack_from("<q", data, off)
+        off += 8
+        dims.append(d)
+    return Request(
+        request_rank=rrank,
+        request_type=RequestType(rtype),
+        tensor_type=DataType(ttype),
+        tensor_name=name,
+        root_rank=root,
+        device=device,
+        tensor_shape=TensorShape(dims),
+        reduce_op=ReduceOp(rop),
+        prescale_factor=pre,
+        postscale_factor=post,
+    ), off
+
+
+def encode_request_list(reqs: List[Request], shutdown: bool = False) -> bytes:
+    buf = bytearray()
+    buf += struct.pack("<BI", 1 if shutdown else 0, len(reqs))
+    for r in reqs:
+        encode_request(r, buf)
+    return bytes(buf)
+
+
+def decode_request_list(data: bytes) -> Tuple[List[Request], bool]:
+    shutdown, n = struct.unpack_from("<BI", data, 0)
+    off = struct.calcsize("<BI")
+    out = []
+    for _ in range(n):
+        r, off = decode_request(data, off)
+        out.append(r)
+    return out, bool(shutdown)
+
+
+def encode_response(resp: Response, buf: bytearray) -> None:
+    buf += struct.pack("<BBI", int(resp.response_type),
+                       int(resp.tensor_type), len(resp.tensor_names))
+    for nm in resp.tensor_names:
+        _pack_str(buf, nm)
+    _pack_str(buf, resp.error_message)
+    buf += struct.pack("<I", len(resp.devices))
+    for d in resp.devices:
+        _pack_str(buf, d)
+    buf += struct.pack("<I", len(resp.tensor_sizes))
+    for s in resp.tensor_sizes:
+        buf += struct.pack("<q", s)
+
+
+def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
+    rtype, ttype, n_names = struct.unpack_from("<BBI", data, off)
+    off += struct.calcsize("<BBI")
+    names = []
+    for _ in range(n_names):
+        nm, off = _unpack_str(data, off)
+        names.append(nm)
+    err, off = _unpack_str(data, off)
+    (n_dev,) = struct.unpack_from("<I", data, off)
+    off += 4
+    devices = []
+    for _ in range(n_dev):
+        d, off = _unpack_str(data, off)
+        devices.append(d)
+    (n_sizes,) = struct.unpack_from("<I", data, off)
+    off += 4
+    sizes = []
+    for _ in range(n_sizes):
+        (s,) = struct.unpack_from("<q", data, off)
+        off += 8
+        sizes.append(s)
+    return Response(
+        response_type=ResponseType(rtype),
+        tensor_type=DataType(ttype),
+        tensor_names=names,
+        error_message=err,
+        devices=devices,
+        tensor_sizes=sizes,
+    ), off
+
+
+def encode_response_list(resps: List[Response],
+                         shutdown: bool = False) -> bytes:
+    buf = bytearray()
+    buf += struct.pack("<BI", 1 if shutdown else 0, len(resps))
+    for r in resps:
+        encode_response(r, buf)
+    return bytes(buf)
+
+
+def decode_response_list(data: bytes) -> Tuple[List[Response], bool]:
+    shutdown, n = struct.unpack_from("<BI", data, 0)
+    off = struct.calcsize("<BI")
+    out = []
+    for _ in range(n):
+        r, off = decode_response(data, off)
+        out.append(r)
+    return out, bool(shutdown)

@@ -1,0 +1,6 @@
+"""Model zoo for benchmarks and parity configs.
+
+Mirrors the reference's benchmark surface (SURVEY.md §6: ResNet-50
+synthetic benchmark, MNIST examples) plus a transformer for the
+long-context / sequence-parallel path.
+"""

@@ -1,0 +1,12 @@
+"""Collective ops: in-graph XLA data plane + eager process-group ops.
+
+``horovod_tpu.ops.collective`` — axis-name collectives for use inside
+``shard_map``/``pjit`` (the TPU/ICI data plane).
+``horovod_tpu.ops.eager``      — host-side eager ops over the engine.
+``horovod_tpu.ops.cpu_backend``— ring algorithms (the correctness oracle).
+``horovod_tpu.ops.adasum``     — scale-invariant reduction (in-graph+eager).
+``horovod_tpu.ops.compression``— fp16/bf16 gradient compression.
+"""
+
+from horovod_tpu.ops import adasum, collective, compression, eager  # noqa
+from horovod_tpu.ops.compression import Compression  # noqa: F401

@@ -1,0 +1,268 @@
+"""CPU data-plane collectives over the TCP mesh: the correctness oracle.
+
+Role parity: ``horovod/common/ops/gloo_operations.cc`` (the reference's CPU
+backend, ring algorithms from the gloo library) and ``mpi_operations.cc``.
+Algorithms:
+
+* allreduce  — ring reduce-scatter + ring allgather (the NCCL/gloo ring),
+  with fp32 per-hop accumulation for 16-bit dtypes matching the reference's
+  custom fp16 MPI op (``half.cc:43-77`` promotes to float to add).
+* allgather  — ragged ring allgatherv driven by the negotiated first-dim
+  sizes in the Response (parity: ``MPIAllgather`` displacement logic,
+  mpi_operations.cc:83-166).
+* broadcast  — star from the root (control-plane scale data; the TPU
+  in-graph path is where broadcast bandwidth matters).
+* alltoall   — size-1 rounds of pairwise exchange.
+* adasum     — recursive distance-doubling partner exchange (see
+  ops/adasum.py for the math; eager variant used when Request.reduce_op is
+  ADASUM, parity: adasum_mpi_operations.cc).
+
+Each transfer is a framed TCP message; sends run on a helper thread so the
+simultaneous send/recv of ring steps cannot deadlock on kernel buffers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from horovod_tpu.common.types import DataType, ReduceOp, Response
+from horovod_tpu.utils import socketutil as su
+
+
+def _np_dtype(dt: DataType):
+    from horovod_tpu.runtime_py import _np_dtype as f
+
+    return f(dt)
+
+
+def _send_async(sock, payload: bytes) -> threading.Thread:
+    t = threading.Thread(
+        target=su.send_frame, args=(sock, su.TAG_DATA, payload), daemon=True)
+    t.start()
+    return t
+
+
+def _recv(sock) -> bytes:
+    tag, payload = su.recv_frame(sock)
+    if tag != su.TAG_DATA:
+        raise ConnectionError(f"expected data frame, got tag {tag}")
+    return payload
+
+
+def _combine(a: np.ndarray, b: np.ndarray, op: ReduceOp) -> np.ndarray:
+    """Per-hop reduction; 16-bit inputs accumulate via fp32 like half.cc."""
+    if a.dtype.name in ("float16", "bfloat16"):
+        a32, b32 = a.astype(np.float32), b.astype(np.float32)
+        out = _combine(a32, b32, op)
+        return out.astype(a.dtype)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        return a + b
+    if op == ReduceOp.MIN:
+        return np.minimum(a, b)
+    if op == ReduceOp.MAX:
+        return np.maximum(a, b)
+    if op == ReduceOp.PRODUCT:
+        return a * b
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def _chunk_bounds(n: int, parts: int) -> List[int]:
+    """NCCL-style near-equal split: bounds[i]..bounds[i+1] is chunk i."""
+    base, rem = divmod(n, parts)
+    bounds = [0]
+    for i in range(parts):
+        bounds.append(bounds[-1] + base + (1 if i < rem else 0))
+    return bounds
+
+
+def ring_allreduce_flat(engine, flat: np.ndarray,
+                        op: ReduceOp) -> np.ndarray:
+    """In-place-style ring allreduce of a flat array; returns the result."""
+    size, rank = engine.size, engine.rank
+    if size == 1:
+        return flat
+    right = engine._data[(rank + 1) % size]
+    left = engine._data[(rank - 1) % size]
+    dtype = flat.dtype
+    bounds = _chunk_bounds(flat.size, size)
+    chunks = [flat[bounds[i]:bounds[i + 1]].copy() for i in range(size)]
+
+    # Phase 1: ring reduce-scatter.
+    for step in range(size - 1):
+        send_idx = (rank - step) % size
+        recv_idx = (rank - step - 1) % size
+        t = _send_async(right, chunks[send_idx].tobytes())
+        incoming = np.frombuffer(_recv(left), dtype=dtype).copy()
+        t.join()
+        chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
+
+    # Phase 2: ring allgather of the reduced chunks.
+    for step in range(size - 1):
+        send_idx = (rank + 1 - step) % size
+        recv_idx = (rank - step) % size
+        t = _send_async(right, chunks[send_idx].tobytes())
+        chunks[recv_idx] = np.frombuffer(_recv(left), dtype=dtype).copy()
+        t.join()
+
+    return np.concatenate([np.atleast_1d(c) for c in chunks]) \
+        if size > 1 else flat
+
+
+def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
+    """Eager Adasum via recursive distance-doubling partner exchange.
+    Power-of-two sizes only (the reference's VHDD also specializes
+    power-of-two and handles the remainder separately — not needed for TPU
+    pods, which are power-of-two)."""
+    size, rank = engine.size, engine.rank
+    if size == 1:
+        return flat
+    if size & (size - 1):
+        raise ValueError("Adasum requires a power-of-two world size")
+    from horovod_tpu.ops.adasum import adasum_pair_numpy
+
+    acc = flat.astype(np.float64)
+    k = 1
+    while k < size:
+        partner = rank ^ k
+        sock = engine._data[partner]
+        t = _send_async(sock, acc.tobytes())
+        other = np.frombuffer(_recv(sock), dtype=np.float64).copy()
+        t.join()
+        if rank < partner:
+            acc = adasum_pair_numpy(acc, other)
+        else:
+            acc = adasum_pair_numpy(other, acc)
+        k *= 2
+    return acc.astype(flat.dtype)
+
+
+def allreduce(engine, entries, resp: Response):
+    """Fused allreduce over all entries of the response."""
+    op = ReduceOp.SUM
+    prescale = postscale = 1.0
+    for e in entries:
+        if e.handle >= 0:  # a real (non-stand-in) entry carries the op
+            op = e.request.reduce_op
+            prescale = e.request.prescale_factor
+            postscale = e.request.postscale_factor
+            break
+    dtype = _np_dtype(resp.tensor_type)
+    flats = [np.ravel(e.array).astype(dtype, copy=False) for e in entries]
+    flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
+    if prescale != 1.0:
+        flat = flat * dtype.type(prescale)
+
+    if op == ReduceOp.ADASUM:
+        reduced = _adasum_flat(engine, flat)
+    else:
+        reduced = ring_allreduce_flat(engine, flat, op)
+
+    if op == ReduceOp.AVERAGE:
+        if dtype.itemsize == 2:
+            reduced = (reduced.astype(np.float32) / engine.size).astype(dtype)
+        else:
+            reduced = reduced / dtype.type(engine.size)
+    if postscale != 1.0:
+        reduced = (reduced * postscale).astype(dtype, copy=False)
+
+    results = []
+    off = 0
+    for e in entries:
+        n = e.array.size
+        results.append(reduced[off:off + n].reshape(e.array.shape))
+        off += n
+    return results
+
+
+def allgather(engine, entries, resp: Response):
+    """Ragged ring allgatherv; one entry per response."""
+    size, rank = engine.size, engine.rank
+    results = []
+    for e in entries:
+        first_dims = resp.tensor_sizes
+        rest_shape = e.array.shape[1:] if e.array.ndim > 0 else ()
+        dtype = _np_dtype(resp.tensor_type)
+        blocks: List[Optional[np.ndarray]] = [None] * size
+        blocks[rank] = np.ascontiguousarray(e.array)
+        if size > 1:
+            right = engine._data[(rank + 1) % size]
+            left = engine._data[(rank - 1) % size]
+            for step in range(size - 1):
+                send_idx = (rank - step) % size
+                recv_idx = (rank - step - 1) % size
+                t = _send_async(right, blocks[send_idx].tobytes())
+                payload = _recv(left)
+                t.join()
+                blk = np.frombuffer(payload, dtype=dtype)
+                blocks[recv_idx] = blk.reshape(
+                    (first_dims[recv_idx],) + rest_shape)
+        results.append(np.concatenate(blocks, axis=0)
+                       if size > 1 else blocks[rank].copy())
+    return results
+
+
+def broadcast(engine, entries, resp: Response):
+    size, rank = engine.size, engine.rank
+    results = []
+    for e in entries:
+        root = int(resp.tensor_sizes[0]) if resp.tensor_sizes \
+            else e.root_rank
+        if size == 1:
+            results.append(e.array.copy())
+            continue
+        if rank == root:
+            payload = np.ascontiguousarray(e.array).tobytes()
+            threads = [_send_async(engine._data[r], payload)
+                       for r in range(size) if r != root]
+            for t in threads:
+                t.join()
+            results.append(e.array.copy())
+        else:
+            payload = _recv(engine._data[root])
+            arr = np.frombuffer(
+                payload, dtype=_np_dtype(resp.tensor_type)).copy()
+            results.append(arr.reshape(e.array.shape))
+    return results
+
+
+def alltoall(engine, entries, resp: Response):
+    size, rank = engine.size, engine.rank
+    results = []
+    for e in entries:
+        splits = e.splits
+        if splits is None:
+            if e.array.shape[0] % size:
+                raise ValueError(
+                    "alltoall without splits requires dim 0 divisible by "
+                    "the world size")
+            per = e.array.shape[0] // size
+            splits = [per] * size
+        offs = np.concatenate([[0], np.cumsum(splits)])
+        my_blocks = [np.ascontiguousarray(
+            e.array[offs[r]:offs[r + 1]]) for r in range(size)]
+        recv_blocks: List[Optional[np.ndarray]] = [None] * size
+        recv_blocks[rank] = my_blocks[rank].copy()
+        rest_shape = e.array.shape[1:]
+        dtype = _np_dtype(resp.tensor_type)
+        for step in range(1, size):
+            dst = (rank + step) % size
+            src = (rank - step) % size
+            t = _send_async(engine._data[dst], my_blocks[dst].tobytes())
+            payload = _recv(engine._data[src])
+            t.join()
+            blk = np.frombuffer(payload, dtype=dtype)
+            if rest_shape:
+                blk = blk.reshape((-1,) + rest_shape)
+            recv_blocks[src] = blk.copy()
+        recv_splits = [b.shape[0] for b in recv_blocks]
+        results.append((np.concatenate(recv_blocks, axis=0)
+                        if recv_blocks else e.array.copy(),
+                        recv_splits))
+    return results
+
+
+def barrier(engine) -> None:
+    ring_allreduce_flat(engine, np.zeros(1, np.int32), ReduceOp.SUM)

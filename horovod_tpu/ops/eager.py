@@ -1,0 +1,249 @@
+"""Eager (op-by-op) collective API over the process-group engine.
+
+Parity: the public op surface of ``horovod/torch/mpi_ops.py`` /
+``horovod/tensorflow/mpi_ops.py``: sync + async variants, ``poll`` /
+``synchronize`` handles, auto-generated tensor names, broadcast_object.
+Framework-agnostic: accepts numpy arrays, JAX arrays, python scalars, and
+torch tensors; results come back in the caller's type.
+
+Inside a ``jit`` trace these functions cannot run (the engine is host-side);
+they raise with a pointer to the in-graph ops in
+``horovod_tpu.ops.collective``, which is the TPU data plane.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.common.types import ReduceOp
+
+_counter_lock = threading.Lock()
+_op_counters: Dict[str, int] = {}
+
+# handle -> postprocess(raw_result) -> user-facing result
+_post: Dict[int, Callable] = {}
+_post_lock = threading.Lock()
+
+
+def _auto_name(kind: str, name: Optional[str]) -> str:
+    """Deterministic fallback names; identical call order across ranks
+    yields identical names (parity: mpi_ops.py noname counters)."""
+    if name is not None:
+        return name
+    with _counter_lock:
+        c = _op_counters.get(kind, 0)
+        _op_counters[kind] = c + 1
+    return f"{kind}.noname.{c}"
+
+
+def _check_not_traced(x) -> None:
+    try:
+        import jax.core
+
+        if isinstance(x, jax.core.Tracer):
+            raise TypeError(
+                "eager horovod_tpu collectives cannot run inside jit/pjit "
+                "traces; use horovod_tpu.ops.collective.* (axis-name based "
+                "in-graph collectives) inside shard_map instead")
+    except ImportError:
+        pass
+
+
+def _to_numpy(x) -> Tuple[np.ndarray, Callable[[np.ndarray], Any]]:
+    """Convert input to numpy + a restore function back to the input type."""
+    _check_not_traced(x)
+    mod = type(x).__module__
+    if mod.startswith("torch"):
+        import torch
+
+        device = x.device
+        arr = x.detach().cpu().numpy()
+        return arr, lambda a: torch.from_numpy(
+            np.ascontiguousarray(a)).to(device)
+    if mod.startswith("jax") or "ArrayImpl" in type(x).__name__:
+        import jax
+        import jax.numpy as jnp
+
+        devs = getattr(x, "devices", None)
+        arr = np.asarray(x)
+        return arr, jnp.asarray
+    arr = np.asarray(x)
+    if arr.dtype == np.float64 and not isinstance(x, np.ndarray):
+        # python floats → fp32, matching framework default behavior
+        arr = arr.astype(np.float32)
+    return arr, lambda a: a
+
+
+def _register(handle: int, fn: Callable) -> int:
+    with _post_lock:
+        _post[handle] = fn
+    return handle
+
+
+def poll(handle: int) -> bool:
+    return basics._engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Wait for an async op; returns its result.
+    Parity: mpi_ops.py synchronize (busy-wait replaced by a condvar)."""
+    raw = basics._engine().synchronize(handle)
+    with _post_lock:
+        fn = _post.pop(handle, None)
+    return fn(raw) if fn else raw
+
+
+def allreduce_async(tensor, name: Optional[str] = None,
+                    op: ReduceOp = ReduceOp.AVERAGE,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    compression=None) -> int:
+    from horovod_tpu.ops.compression import Compression
+
+    compression = compression or Compression.none
+    arr, restore = _to_numpy(tensor)
+    # Eager compression operates on numpy: cast down before the wire.
+    comp_arr, ctx = _np_compress(compression, arr)
+    h = basics._engine().allreduce_async(
+        _auto_name("allreduce", name), comp_arr, op=op,
+        prescale=prescale_factor, postscale=postscale_factor)
+
+    def post(raw):
+        raw = _np_decompress(compression, raw, ctx)
+        return restore(raw)
+
+    return _register(h, post)
+
+
+def _np_compress(compression, arr):
+    from horovod_tpu.ops import compression as C
+
+    if compression is C.Compression.none or compression is C.NoneCompressor:
+        return arr, None
+    wire = np.dtype("float16") if compression is C.Float16Compressor \
+        else _bf16_dtype()
+    if arr.dtype.kind == "f" and arr.dtype != wire:
+        return arr.astype(wire), arr.dtype
+    return arr, None
+
+
+def _np_decompress(compression, arr, ctx):
+    if ctx is not None:
+        return arr.astype(ctx)
+    return arr
+
+
+def _bf16_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def allreduce(tensor, name: Optional[str] = None,
+              op: ReduceOp = ReduceOp.AVERAGE,
+              prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0,
+              compression=None):
+    return synchronize(allreduce_async(
+        tensor, name, op, prescale_factor, postscale_factor, compression))
+
+
+def grouped_allreduce(tensors: List, name: Optional[str] = None,
+                      op: ReduceOp = ReduceOp.AVERAGE) -> List:
+    """Eager grouped allreduce; entries negotiate individually but fuse in
+    the controller exactly like individually-submitted tensors do."""
+    base = _auto_name("grouped_allreduce", name)
+    handles = [allreduce_async(t, f"{base}.{i}", op)
+               for i, t in enumerate(tensors)]
+    return [synchronize(h) for h in handles]
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> int:
+    arr, restore = _to_numpy(tensor)
+    h = basics._engine().allgather_async(_auto_name("allgather", name), arr)
+    return _register(h, restore)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank: int = 0,
+                    name: Optional[str] = None) -> int:
+    arr, restore = _to_numpy(tensor)
+    h = basics._engine().broadcast_async(
+        _auto_name("broadcast", name), arr, root_rank=root_rank)
+    return _register(h, restore)
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+    arr, restore = _to_numpy(tensor)
+    if splits is not None:
+        splits = list(np.asarray(splits).astype(int))
+    h = basics._engine().alltoall_async(
+        _auto_name("alltoall", name), arr, splits=splits)
+
+    def post(raw):
+        if isinstance(raw, tuple):
+            data, recv_splits = raw
+            return restore(data), recv_splits
+        return restore(raw)
+
+    return _register(h, post)
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None):
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+def barrier() -> None:
+    basics._engine().barrier()
+
+
+def join() -> int:
+    """Fault-tolerant data exhaustion; parity: torch/mpi_ops.py:494-510
+    and SURVEY.md §3.5.  Returns the last rank that joined."""
+    return basics._engine().join()
+
+
+def broadcast_object(obj, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle-based arbitrary-object broadcast;
+    parity: torch/__init__.py:607 (cloudpickle there, stdlib pickle here —
+    user fns cross process boundaries via the launcher, not this call)."""
+    name = _auto_name("broadcast_object", name)
+    if basics.rank() == root_rank:
+        payload = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8).copy()
+        n = np.array([payload.size], np.int64)
+    else:
+        payload = None
+        n = np.zeros(1, np.int64)
+    n = broadcast(n, root_rank, name=f"{name}.len")
+    if payload is None:
+        payload = np.zeros(int(n[0]), np.uint8)
+    payload = broadcast(payload, root_rank, name=f"{name}.data")
+    return pickle.loads(payload.tobytes())
+
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         prefix: str = "bcast_param") -> Any:
+    """Broadcast every array leaf of a pytree / dict of parameters from
+    ``root_rank``; returns the synchronized structure.
+    Parity: torch/__init__.py:451 broadcast_parameters."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    handles = [broadcast_async(leaf, root_rank, name=f"{prefix}.{i}")
+               for i, leaf in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, [synchronize(h) for h in handles])

@@ -1,0 +1,27 @@
+"""Parallelism layer: meshes, sharded steps, distributed optimizers.
+
+TPU-native scaling machinery (SPMD over ``jax.sharding.Mesh``): data
+parallelism (the reference's only axis), plus tensor / pipeline / sequence
+/ expert axes and hierarchical ICI+DCN reduction, which complete the
+framework for modern model scale (SURVEY.md §5 long-context note).
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    CROSS_AXIS,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    data_parallel_axes,
+    make_hierarchical_mesh,
+    make_mesh,
+    mesh_axis_size,
+    num_slices,
+)
+from horovod_tpu.parallel.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    allreduce_gradients,
+    distributed_grad,
+    distributed_value_and_grad,
+)

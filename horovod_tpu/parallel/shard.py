@@ -1,0 +1,37 @@
+"""Version-portable ``shard_map`` wrapper.
+
+JAX moved ``shard_map`` from ``jax.experimental`` to ``jax.shard_map`` and
+added varying-manual-axes (VMA) replication checking; collective-heavy
+bodies (all_gather outputs consumed as replicated) frequently defeat the
+static inference, so we default ``check_vma=False`` — the collectives in
+``horovod_tpu.ops.collective`` define their own replication semantics.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+
+def _resolve():
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+    return fn
+
+
+_SHARD_MAP = _resolve()
+_PARAMS = set(inspect.signature(_SHARD_MAP).parameters)
+
+
+def shard_map(f, mesh, in_specs, out_specs, **kwargs: Any):
+    """``shard_map(f, mesh, in_specs, out_specs)`` with VMA checking off
+    unless explicitly requested."""
+    if "check_vma" in _PARAMS:
+        kwargs.setdefault("check_vma", False)
+    elif "check_rep" in _PARAMS:
+        kwargs.setdefault("check_rep", False)
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -1,0 +1,6 @@
+"""Launcher subsystem: rendezvous server, host/slot allocation, CLI.
+
+Parity: ``horovod/run/`` (horovodrun CLI, gloo_run slot allocation,
+RendezvousServer).  The TPU twist: besides ``-H host:slots`` the launcher
+can derive world topology from TPU slice metadata (see ``discovery.py``).
+"""

@@ -1,0 +1,77 @@
+"""KV client for the rendezvous server.
+
+Parity: ``horovod/run/http/http_client.py`` (read_data_from_kvstore /
+put_data_into_kvstore).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+class KVClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    def _url(self, key: str) -> str:
+        return f"http://{self.host}:{self.port}/kv/{key}"
+
+    def put(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        req = urllib.request.Request(
+            self._url(key), data=value, method="PUT")
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(self._url(key), timeout=10) as r:
+                return r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def get_bytes(self, key: str) -> Optional[bytes]:
+        try:
+            with urllib.request.urlopen(self._url(key), timeout=10) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete(self, key: str) -> None:
+        req = urllib.request.Request(self._url(key), method="DELETE")
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+
+    def wait_get(self, key: str, timeout: float = 60.0,
+                 interval: float = 0.05) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"rendezvous key {key!r} not available "
+                           f"after {timeout}s")
+
+    def local_address(self) -> Optional[str]:
+        """The local interface address that routes to the rendezvous server
+        — lets a worker advertise a peer-reachable address without NIC
+        configuration (the reference runs a NIC-discovery ring instead,
+        run/driver/driver_service.py:128-198)."""
+        try:
+            s = socket.create_connection((self.host, self.port), timeout=5)
+            addr = s.getsockname()[0]
+            s.close()
+            return addr
+        except OSError:
+            return None

@@ -1,0 +1,97 @@
+"""Rendezvous / KV HTTP server.
+
+Parity: ``horovod/run/http/http_server.py`` (RendezvousServer +
+KVStoreServer: a scoped PUT/GET/DELETE key-value store that workers use to
+exchange addresses at startup and to return run-function results).
+
+Protocol: ``PUT /kv/<key>`` stores the body; ``GET /kv/<key>`` returns it or
+404; ``DELETE /kv/<key>`` removes it; ``GET /health`` returns ``ok``.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _store(self) -> Dict[str, bytes]:
+        return self.server.kv_store  # type: ignore[attr-defined]
+
+    def do_GET(self):
+        if self.path == "/health":
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            val = self._store().get(key) if key else None
+        if val is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(val)))
+        self.end_headers()
+        self.wfile.write(val)
+
+    def do_PUT(self):
+        key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
+        n = int(self.headers.get("Content-Length", "0"))
+        body = self.rfile.read(n)
+        if key:
+            with self.server.kv_lock:  # type: ignore[attr-defined]
+                self._store()[key] = body
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
+        with self.server.kv_lock:  # type: ignore[attr-defined]
+            self._store().pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Threaded KV server; start() returns the bound port."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.kv_store = {}  # type: ignore[attr-defined]
+        self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-rendezvous",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+    # Direct access for the launcher process (collecting results).
+    def get(self, key: str) -> Optional[bytes]:
+        with self._httpd.kv_lock:  # type: ignore[attr-defined]
+            return self._httpd.kv_store.get(key)  # type: ignore

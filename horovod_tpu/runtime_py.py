@@ -1,0 +1,841 @@
+"""Pure-Python process-group engine: controller + CPU data plane.
+
+This is a complete, wire-compatible implementation of the coordination
+protocol that the native C++ core (``csrc/``) also implements; it serves as
+(a) the always-available fallback when the extension is not built, and
+(b) the executable specification the native core is tested against.
+
+Behavioral parity map (reference → here):
+* ``horovod/common/operations.cc:333-589`` BackgroundThreadLoop /
+  RunLoopOnce            → ``PyEngine._background_loop`` / ``_run_loop_once``
+* ``horovod/common/controller.cc:62-354`` ComputeResponseList
+  (coordinator negotiation, rank-0 message table)
+                          → ``_coordinator_cycle`` / ``_MessageTable``
+* ``horovod/common/controller.cc:376-609`` ConstructResponse (mismatch
+  checking)               → ``_construct_response``
+* ``horovod/common/controller.cc:638-759`` FuseResponses
+                          → ``_fuse_responses``
+* ``horovod/common/tensor_queue.cc``        → ``_pending`` + ``_table``
+* ``horovod/torch/handle_manager.h:31-42``  → ``HandleManager``
+* ``horovod/common/stall_inspector.cc``     → ``_check_stalls``
+* ``horovod/common/ops/gloo_operations.cc`` (CPU data plane)
+                          → ``horovod_tpu.ops.cpu_backend`` (ring algorithms)
+
+The controller is a star over TCP (workers → rank 0), like the reference's
+coordinator; the data plane is a full mesh running ring collectives.  All
+of it is host-network traffic — on TPU the performance path is the in-graph
+XLA backend (``horovod_tpu.ops.collective``); this engine exists for
+Horovod-style multi-process eager semantics and as the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common import wire
+from horovod_tpu.common.types import (
+    DataType,
+    ReduceOp,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+    Status,
+    StatusType,
+    TensorShape,
+)
+from horovod_tpu.common.types import dtype_from_numpy, dtype_to_numpy_name
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import socketutil as su
+from horovod_tpu.utils import timeline as timeline_mod
+from horovod_tpu.utils.logging import get_logger
+
+_OP_NAMES = {
+    RequestType.ALLREDUCE: "ALLREDUCE",
+    RequestType.ALLGATHER: "ALLGATHER",
+    RequestType.BROADCAST: "BROADCAST",
+    RequestType.ALLTOALL: "ALLTOALL",
+    RequestType.JOIN: "JOIN",
+    RequestType.BARRIER: "BARRIER",
+}
+
+
+class HandleManager:
+    """Async handle table; parity: torch/handle_manager.h:31-42."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._next = 0
+        self._status: Dict[int, Optional[Status]] = {}
+        self._result: Dict[int, object] = {}
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._status[h] = None
+            return h
+
+    def mark_done(self, handle: int, status: Status, result=None) -> None:
+        with self._cv:
+            self._status[handle] = status
+            self._result[handle] = result
+            self._cv.notify_all()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._status:
+                raise ValueError(f"unknown handle {handle}")
+            return self._status[handle] is not None
+
+    def wait(self, handle: int, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._status.get(handle) is None:
+                remaining = None if deadline is None else max(
+                    0.0, deadline - time.monotonic())
+                if deadline is not None and remaining == 0.0:
+                    raise TimeoutError(f"handle {handle} timed out")
+                self._cv.wait(remaining)
+            status = self._status.pop(handle)
+            result = self._result.pop(handle, None)
+        if not status.ok_():
+            raise RuntimeError(status.reason or "collective failed")
+        return result
+
+
+@dataclass
+class TensorTableEntry:
+    """One enqueued tensor awaiting its collective.
+    Parity: common.h TensorTableEntry."""
+
+    name: str
+    array: np.ndarray
+    handle: int
+    request: Request
+    root_rank: int = -1
+    splits: Optional[List[int]] = None
+    enqueue_ns: int = field(default_factory=time.monotonic_ns)
+
+
+class _MessageTable:
+    """Coordinator-side ready-count tracking.
+    Parity: controller.h:33 MessageTable + IncrementTensorCount
+    (controller.cc:787-810)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.entries: Dict[str, List[Request]] = {}
+        self.first_seen: Dict[str, float] = {}
+
+    def increment(self, req: Request, joined_size: int) -> bool:
+        """Record a rank's readiness; True when all non-joined ranks are in."""
+        lst = self.entries.setdefault(req.tensor_name, [])
+        lst.append(req)
+        self.first_seen.setdefault(req.tensor_name, time.monotonic())
+        return len(lst) == self.size - joined_size
+
+    def pop(self, name: str) -> List[Request]:
+        self.first_seen.pop(name, None)
+        return self.entries.pop(name)
+
+
+def _np_dtype(dt: DataType):
+    name = dtype_to_numpy_name(dt)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class _EngineBase:
+    """Shared enqueue-side logic and introspection."""
+
+    def __init__(self, rank, size, local_rank, local_size,
+                 cross_rank, cross_size):
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+        self.is_homogeneous = True
+        self.handles = HandleManager()
+        self._pending_names: set = set()
+        self._name_lock = threading.Lock()
+
+    # -- duplicate-name guard (parity: tensor_queue.cc:27-35) -------------
+
+    def _claim_name(self, name: str) -> None:
+        with self._name_lock:
+            if name in self._pending_names:
+                raise ValueError(
+                    f"Requested a collective on a tensor with the same name "
+                    f"as another tensor that is currently being processed: "
+                    f"{name}")
+            self._pending_names.add(name)
+
+    def _release_name(self, name: str) -> None:
+        with self._name_lock:
+            self._pending_names.discard(name)
+
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None):
+        return self.handles.wait(handle, timeout)
+
+
+class SingleProcessEngine(_EngineBase):
+    """size == 1: every collective is the identity (modulo scaling), applied
+    synchronously.  Keeps the async handle API so user code is unchanged."""
+
+    def __init__(self):
+        super().__init__(0, 1, 0, 1, 0, 1)
+        self.timeline = timeline_mod.from_env(0)
+
+    def shutdown(self):
+        self.timeline.shutdown()
+
+    def _finish(self, name, op_name, result):
+        self.timeline.negotiate_start(name, op_name)
+        self.timeline.negotiate_rank_ready(name, 0)
+        self.timeline.negotiate_end(name)
+        self.timeline.start(name, op_name)
+        self.timeline.end(name)
+        h = self.handles.allocate()
+        self.handles.mark_done(h, Status.ok(), result)
+        return h
+
+    def allreduce_async(self, name, array, op=ReduceOp.SUM,
+                        prescale=1.0, postscale=1.0):
+        out = np.asarray(array)
+        if prescale != 1.0 or postscale != 1.0:
+            out = out * (prescale * postscale)
+        else:
+            out = out.copy()
+        return self._finish(name, "ALLREDUCE", out)
+
+    def allgather_async(self, name, array):
+        return self._finish(name, "ALLGATHER", np.asarray(array).copy())
+
+    def broadcast_async(self, name, array, root_rank=0):
+        if root_rank != 0:
+            raise ValueError(
+                f"broadcast root rank {root_rank} out of range for size 1")
+        return self._finish(name, "BROADCAST", np.asarray(array).copy())
+
+    def alltoall_async(self, name, array, splits=None):
+        return self._finish(name, "ALLTOALL", np.asarray(array).copy())
+
+    def barrier(self):
+        return None
+
+    def join(self) -> int:
+        return 0
+
+
+class PyEngine(_EngineBase):
+    """Multi-process engine: background thread, star controller, ring data
+    plane.  See module docstring for the parity map."""
+
+    def __init__(self, rank, size, local_rank, local_size,
+                 cross_rank, cross_size, rdv_addr, rdv_port):
+        super().__init__(rank, size, local_rank, local_size,
+                         cross_rank, cross_size)
+        self.log = get_logger(rank)
+        self.timeline = timeline_mod.from_env(rank)
+        self.cycle_time = env_util.cycle_time_ms() / 1e3
+        self.fusion_threshold = env_util.fusion_threshold_bytes()
+        self.stall_warn_s = env_util.get_float(env_util.STALL_CHECK_TIME, 60.0)
+        self.stall_shutdown_s = env_util.get_float(
+            env_util.STALL_SHUTDOWN_TIME, 0.0)
+        self.stall_check_disable = env_util.get_bool(
+            env_util.STALL_CHECK_DISABLE, False)
+        self.native_fallback_reason = None
+
+        # request queue (tensor queue) + tensor table
+        self._queue_lock = threading.Lock()
+        self._request_queue: List[Request] = []
+        self._table: Dict[str, TensorTableEntry] = {}
+
+        # join state
+        self._joined = False
+        self._join_handle: Optional[int] = None
+        self._last_joined_rank = -1
+
+        # shutdown
+        self._shutdown_flag = threading.Event()
+        self._aborted = False
+
+        # coordinator state
+        self._msg_table = _MessageTable(size) if rank == 0 else None
+        self._joined_ranks: set = set()
+        self._ctrl_inbox: "list" = []
+        self._ctrl_lock = threading.Lock()
+        self._last_stall_check = time.monotonic()
+
+        self._bootstrap(rdv_addr, rdv_port)
+
+        self._bg = threading.Thread(
+            target=self._background_loop, name="hvd-background", daemon=True)
+        self._bg.start()
+
+    # ------------------------------------------------------------------
+    # bootstrap: rendezvous + socket meshes
+    # ------------------------------------------------------------------
+
+    def _bootstrap(self, rdv_addr: str, rdv_port: int) -> None:
+        from horovod_tpu.runner.http_client import KVClient
+
+        kv = KVClient(rdv_addr, rdv_port)
+        listener = su.listen_on()
+        port = listener.getsockname()[1]
+        # Learn the address peers can reach us at from the route the
+        # rendezvous connection takes (works multi-host without NIC config).
+        my_host = kv.local_address() or "127.0.0.1"
+        kv.put(f"hvd/addr/{self.rank}", f"{my_host}:{port}")
+        peers = {}
+        for i in range(self.size):
+            if i == self.rank:
+                continue
+            v = kv.wait_get(f"hvd/addr/{i}", timeout=120.0)
+            host, p = v.rsplit(":", 1)
+            peers[i] = (host, int(p))
+
+        # Full data mesh + a ctrl connection worker->rank0.  A rank
+        # connects to every lower rank; accepts from every higher one.
+        self._data: Dict[int, socket.socket] = {}
+        self._ctrl_sock: Optional[socket.socket] = None
+        self._ctrl_socks: Dict[int, socket.socket] = {}  # rank0 only
+
+        n_accept = self.size - 1 - self.rank
+        if self.rank == 0:
+            n_accept += self.size - 1  # ctrl connections
+        accept_results = {}
+
+        def _accept_loop():
+            for _ in range(n_accept):
+                s, _addr = listener.accept()
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                hdr = su.recv_exact(s, 8)
+                peer_rank, chan = struct.unpack("<ii", hdr)
+                accept_results[(peer_rank, chan)] = s
+
+        acceptor = threading.Thread(target=_accept_loop, daemon=True)
+        acceptor.start()
+
+        for j in range(self.rank):
+            s = su.connect_retry(*peers[j], timeout=120.0)
+            s.sendall(struct.pack("<ii", self.rank, 0))
+            self._data[j] = s
+        if self.rank != 0:
+            s = su.connect_retry(*peers[0], timeout=120.0)
+            s.sendall(struct.pack("<ii", self.rank, 1))
+            self._ctrl_sock = s
+
+        acceptor.join(timeout=180.0)
+        if acceptor.is_alive():
+            raise ConnectionError("timed out waiting for peer connections")
+        for (peer_rank, chan), s in accept_results.items():
+            if chan == 0:
+                self._data[peer_rank] = s
+            else:
+                self._ctrl_socks[peer_rank] = s
+        listener.close()
+
+        # ctrl receiver threads
+        if self.rank == 0:
+            for r, s in self._ctrl_socks.items():
+                threading.Thread(target=self._ctrl_recv_loop,
+                                 args=(r, s), daemon=True).start()
+        else:
+            threading.Thread(target=self._worker_recv_loop, daemon=True
+                             ).start()
+        self._response_inbox: List[bytes] = []
+        self._response_lock = threading.Lock()
+        self._response_cv = threading.Condition(self._response_lock)
+
+    def _ctrl_recv_loop(self, peer_rank: int, sock: socket.socket) -> None:
+        try:
+            while not self._shutdown_flag.is_set():
+                tag, payload = su.recv_frame(sock)
+                if tag == su.TAG_REQUEST_LIST:
+                    with self._ctrl_lock:
+                        self._ctrl_inbox.append((peer_rank, payload))
+        except (ConnectionError, OSError):
+            pass
+
+    def _worker_recv_loop(self) -> None:
+        try:
+            while not self._shutdown_flag.is_set():
+                tag, payload = su.recv_frame(self._ctrl_sock)
+                if tag == su.TAG_RESPONSE_LIST:
+                    with self._response_cv:
+                        self._response_inbox.append(payload)
+                        self._response_cv.notify_all()
+        except (ConnectionError, OSError):
+            pass
+
+    # ------------------------------------------------------------------
+    # enqueue API (framework-thread side)
+    # ------------------------------------------------------------------
+
+    def _enqueue(self, entry: TensorTableEntry) -> int:
+        if self._aborted or self._shutdown_flag.is_set():
+            raise RuntimeError("horovod_tpu runtime has been shut down")
+        self._claim_name(entry.name)
+        with self._queue_lock:
+            self._table[entry.name] = entry
+            self._request_queue.append(entry.request)
+        return entry.handle
+
+    def allreduce_async(self, name, array, op=ReduceOp.SUM,
+                        prescale=1.0, postscale=1.0):
+        arr = np.ascontiguousarray(array)
+        req = Request(
+            request_rank=self.rank,
+            request_type=RequestType.ALLREDUCE,
+            tensor_type=dtype_from_numpy(arr.dtype),
+            tensor_name=name,
+            device="cpu",
+            tensor_shape=TensorShape(arr.shape),
+            reduce_op=op,
+            prescale_factor=prescale,
+            postscale_factor=postscale,
+        )
+        h = self.handles.allocate()
+        return self._enqueue(TensorTableEntry(name, arr, h, req))
+
+    def allgather_async(self, name, array):
+        arr = np.ascontiguousarray(array)
+        req = Request(
+            request_rank=self.rank,
+            request_type=RequestType.ALLGATHER,
+            tensor_type=dtype_from_numpy(arr.dtype),
+            tensor_name=name,
+            device="cpu",
+            tensor_shape=TensorShape(arr.shape),
+        )
+        h = self.handles.allocate()
+        return self._enqueue(TensorTableEntry(name, arr, h, req))
+
+    def broadcast_async(self, name, array, root_rank=0):
+        arr = np.ascontiguousarray(array)
+        if not (0 <= root_rank < self.size):
+            raise ValueError(
+                f"broadcast root rank {root_rank} out of range "
+                f"[0, {self.size})")
+        req = Request(
+            request_rank=self.rank,
+            request_type=RequestType.BROADCAST,
+            tensor_type=dtype_from_numpy(arr.dtype),
+            tensor_name=name,
+            device="cpu",
+            tensor_shape=TensorShape(arr.shape),
+            root_rank=root_rank,
+        )
+        h = self.handles.allocate()
+        return self._enqueue(
+            TensorTableEntry(name, arr, h, req, root_rank=root_rank))
+
+    def alltoall_async(self, name, array, splits=None):
+        arr = np.ascontiguousarray(array)
+        if splits is not None:
+            splits = [int(s) for s in splits]
+            if sum(splits) != arr.shape[0]:
+                raise ValueError("splits must sum to dim 0")
+        req = Request(
+            request_rank=self.rank,
+            request_type=RequestType.ALLTOALL,
+            tensor_type=dtype_from_numpy(arr.dtype),
+            tensor_name=name,
+            device="cpu",
+            tensor_shape=TensorShape(arr.shape),
+        )
+        h = self.handles.allocate()
+        entry = TensorTableEntry(name, arr, h, req, splits=splits)
+        return self._enqueue(entry)
+
+    def barrier(self):
+        name = f"__barrier.{self.handles._next}"
+        req = Request(request_rank=self.rank,
+                      request_type=RequestType.BARRIER,
+                      tensor_name=name, device="cpu")
+        h = self.handles.allocate()
+        self._enqueue(TensorTableEntry(
+            name, np.zeros(1, np.int32), h, req))
+        return self.handles.wait(h)
+
+    def join(self) -> int:
+        """Block until every rank has joined; parity: §3.5 of SURVEY.md."""
+        req = Request(request_rank=self.rank, request_type=RequestType.JOIN,
+                      tensor_name="__join__", device="cpu")
+        h = self.handles.allocate()
+        with self._queue_lock:
+            self._joined = True
+            self._join_handle = h
+            self._request_queue.append(req)
+        self.handles.wait(h)
+        return self._last_joined_rank
+
+    def shutdown(self):
+        if self._shutdown_flag.is_set():
+            return
+        self._shutdown_flag.set()
+        self._bg.join(timeout=10)
+        self.timeline.shutdown()
+        for s in list(self._data.values()) + list(self._ctrl_socks.values()):
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._ctrl_sock is not None:
+            try:
+                self._ctrl_sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # background loop
+    # ------------------------------------------------------------------
+
+    def _background_loop(self):
+        try:
+            while not self._shutdown_flag.is_set():
+                t0 = time.monotonic()
+                self.timeline.mark_cycle_start()
+                if not self._run_loop_once():
+                    break
+                dt = time.monotonic() - t0
+                if dt < self.cycle_time:
+                    time.sleep(self.cycle_time - dt)
+        except Exception as e:  # deliver failure to all pending handles
+            self.log.error("background loop failed: %r", e)
+            self._abort(str(e))
+        finally:
+            self._drain_on_shutdown()
+
+    def _drain_on_shutdown(self):
+        # Parity: SHUT_DOWN_ERROR delivered to pending callbacks
+        # (operations.cc:515-521).
+        with self._queue_lock:
+            entries = list(self._table.values())
+            self._table.clear()
+            self._request_queue.clear()
+            jh, self._join_handle = self._join_handle, None
+        for e in entries:
+            self._release_name(e.name)
+            self.handles.mark_done(
+                e.handle,
+                Status.aborted("Horovod has been shut down."), None)
+        if jh is not None:
+            self.handles.mark_done(jh, Status.ok(), None)
+
+    def _run_loop_once(self) -> bool:
+        with self._queue_lock:
+            msgs = self._request_queue
+            self._request_queue = []
+        if self.rank == 0:
+            return self._coordinator_cycle(msgs)
+        return self._worker_cycle(msgs)
+
+    # -- worker ---------------------------------------------------------
+
+    def _worker_cycle(self, msgs: List[Request]) -> bool:
+        if msgs:
+            payload = wire.encode_request_list(msgs, shutdown=False)
+            try:
+                su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
+            except (ConnectionError, OSError):
+                self._abort("lost connection to coordinator")
+                return False
+        with self._response_lock:
+            inbox = self._response_inbox
+            self._response_inbox = []
+        for payload in inbox:
+            responses, shutdown = wire.decode_response_list(payload)
+            for resp in responses:
+                self._perform_operation(resp)
+            if shutdown:
+                self._shutdown_flag.set()
+                return False
+        return True
+
+    # -- coordinator ----------------------------------------------------
+
+    def _coordinator_cycle(self, msgs: List[Request]) -> bool:
+        ready: List[str] = []
+        shutdown = False
+
+        def _absorb(req: Request) -> None:
+            nonlocal ready, shutdown
+            if req.request_type == RequestType.JOIN:
+                self._joined_ranks.add(req.request_rank)
+                self._last_joined_rank = req.request_rank
+                # Tensors waiting only on joined ranks become ready.
+                for nm, lst in list(self._msg_table.entries.items()):
+                    if len(lst) == self.size - len(self._joined_ranks):
+                        if nm not in ready:
+                            ready.append(nm)
+                return
+            if self.timeline.enabled and req.request_rank == 0:
+                self.timeline.negotiate_start(
+                    req.tensor_name, _OP_NAMES[req.request_type])
+            if self.timeline.enabled:
+                self.timeline.negotiate_rank_ready(
+                    req.tensor_name, req.request_rank)
+            if self._msg_table.increment(req, len(self._joined_ranks)):
+                ready.append(req.tensor_name)
+
+        for req in msgs:
+            _absorb(req)
+        with self._ctrl_lock:
+            inbox = self._ctrl_inbox
+            self._ctrl_inbox = []
+        for _peer, payload in inbox:
+            reqs, peer_shutdown = wire.decode_request_list(payload)
+            shutdown = shutdown or peer_shutdown
+            for req in reqs:
+                _absorb(req)
+
+        responses: List[Response] = []
+        for name in ready:
+            reqs = self._msg_table.pop(name)
+            if self.timeline.enabled:
+                self.timeline.negotiate_end(name)
+            responses.append(self._construct_response(name, reqs))
+
+        if len(self._joined_ranks) == self.size:
+            responses.append(Response(
+                response_type=ResponseType.JOIN,
+                tensor_sizes=[self._last_joined_rank]))
+            self._joined_ranks = set()
+
+        if not self.stall_check_disable:
+            shutdown = self._check_stalls() or shutdown
+
+        if responses or shutdown:
+            fused = self._fuse_responses(responses)
+            payload = wire.encode_response_list(fused, shutdown=shutdown)
+            for s in self._ctrl_socks.values():
+                try:
+                    su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
+                except (ConnectionError, OSError):
+                    pass
+            for resp in fused:
+                self._perform_operation(resp)
+            if shutdown:
+                self._shutdown_flag.set()
+                return False
+        return True
+
+    def _check_stalls(self) -> bool:
+        now = time.monotonic()
+        if now - self._last_stall_check < self.stall_warn_s / 4:
+            return False
+        self._last_stall_check = now
+        shutdown = False
+        for name, t0 in self._msg_table.first_seen.items():
+            waited = now - t0
+            if waited > self.stall_warn_s:
+                have = sorted(r.request_rank
+                              for r in self._msg_table.entries[name])
+                missing = [r for r in range(self.size)
+                           if r not in have and
+                           r not in self._joined_ranks]
+                self.log.warning(
+                    "Stalled tensor %s: ready on ranks %s, waiting on %s "
+                    "for %.0fs", name, have, missing, waited)
+                if self.stall_shutdown_s > 0 and \
+                        waited > self.stall_shutdown_s:
+                    self.log.error(
+                        "Stalled tensor %s exceeded shutdown threshold; "
+                        "shutting down", name)
+                    shutdown = True
+        return shutdown
+
+    # -- response construction (parity: ConstructResponse) --------------
+
+    def _construct_response(self, name: str, reqs: List[Request]) -> Response:
+        first = reqs[0]
+        err = None
+        if any(r.request_type != first.request_type for r in reqs):
+            err = (f"Mismatched collective operations for tensor {name}: "
+                   + ", ".join(sorted({_OP_NAMES[r.request_type]
+                                       for r in reqs})))
+        elif any(r.tensor_type != first.tensor_type for r in reqs):
+            err = (f"Mismatched data types for tensor {name}: "
+                   + ", ".join(sorted({r.tensor_type.name for r in reqs})))
+        elif first.request_type == RequestType.ALLREDUCE:
+            if any(r.tensor_shape != first.tensor_shape for r in reqs):
+                err = (f"Mismatched allreduce tensor shapes for {name}: "
+                       + ", ".join(sorted({str(r.tensor_shape)
+                                           for r in reqs})))
+            elif any(r.reduce_op != first.reduce_op for r in reqs):
+                err = f"Mismatched reduce ops for tensor {name}"
+        elif first.request_type == RequestType.BROADCAST:
+            if any(r.root_rank != first.root_rank for r in reqs):
+                err = (f"Mismatched broadcast root ranks for {name}: "
+                       + ", ".join(sorted({str(r.root_rank)
+                                           for r in reqs})))
+            elif any(r.tensor_shape != first.tensor_shape for r in reqs):
+                err = f"Mismatched broadcast tensor shapes for {name}"
+        elif first.request_type == RequestType.ALLGATHER:
+            for r in reqs:
+                if r.tensor_shape.rank != first.tensor_shape.rank or \
+                        r.tensor_shape.dims[1:] != first.tensor_shape.dims[1:]:
+                    err = (f"Mismatched allgather tensor shapes for {name}: "
+                           f"all dimensions except the first must match")
+                    break
+
+        if err is not None:
+            return Response(response_type=ResponseType.ERROR,
+                            tensor_names=[name], error_message=err)
+
+        resp = Response(
+            response_type=ResponseType(int(first.request_type)),
+            tensor_names=[name],
+            tensor_type=first.tensor_type,
+            devices=[first.device],
+        )
+        if first.request_type == RequestType.ALLREDUCE:
+            resp.tensor_sizes = [first.tensor_shape.num_elements]
+        elif first.request_type == RequestType.ALLGATHER:
+            # First-dim size per rank, in rank order (0 for joined ranks).
+            by_rank = {r.request_rank: r for r in reqs}
+            resp.tensor_sizes = [
+                by_rank[r].tensor_shape.dims[0] if r in by_rank else 0
+                for r in range(self.size)]
+        elif first.request_type == RequestType.BROADCAST:
+            resp.tensor_sizes = [first.root_rank]
+        return resp
+
+    # -- fusion (parity: FuseResponses, controller.cc:638-759) -----------
+
+    def _fuse_responses(self, responses: List[Response]) -> List[Response]:
+        out: List[Response] = []
+        pending: Optional[Response] = None
+        pending_bytes = 0
+        for r in responses:
+            fusable = (r.response_type == ResponseType.ALLREDUCE
+                       and not r.error_message)
+            if not fusable:
+                if pending is not None:
+                    out.append(pending)
+                    pending = None
+                out.append(r)
+                continue
+            nbytes = sum(r.tensor_sizes) * r.tensor_type.itemsize
+            if pending is not None and \
+                    pending.tensor_type == r.tensor_type and \
+                    pending.devices == r.devices and \
+                    pending_bytes + nbytes <= self.fusion_threshold:
+                pending.tensor_names.extend(r.tensor_names)
+                pending.tensor_sizes.extend(r.tensor_sizes)
+                pending_bytes += nbytes
+            else:
+                if pending is not None:
+                    out.append(pending)
+                pending = r
+                pending_bytes = nbytes
+        if pending is not None:
+            out.append(pending)
+        return out
+
+    # -- execution -------------------------------------------------------
+
+    def _get_entries(self, resp: Response) -> List[TensorTableEntry]:
+        """Fetch (or zero-allocate, when joined) the entries of a response.
+        Parity: GetTensorEntriesFromResponse (tensor_queue.cc:72-117)."""
+        entries = []
+        with self._queue_lock:
+            for i, nm in enumerate(resp.tensor_names):
+                if nm in self._table:
+                    entries.append(self._table.pop(nm))
+                else:
+                    # This rank joined: allocate a zero stand-in.
+                    dt = _np_dtype(resp.tensor_type)
+                    if resp.response_type == ResponseType.ALLREDUCE:
+                        n = resp.tensor_sizes[i]
+                        arr = np.zeros(n, dt)
+                    elif resp.response_type == ResponseType.ALLGATHER:
+                        arr = np.zeros(0, dt)
+                    else:
+                        arr = np.zeros(0, dt)
+                    req = Request(request_rank=self.rank,
+                                  tensor_name=nm,
+                                  tensor_type=resp.tensor_type,
+                                  tensor_shape=TensorShape(arr.shape))
+                    entries.append(
+                        TensorTableEntry(nm, arr, -1, req))
+        return entries
+
+    def _perform_operation(self, resp: Response) -> None:
+        from horovod_tpu.ops import cpu_backend
+
+        if resp.response_type == ResponseType.JOIN:
+            self._last_joined_rank = int(resp.tensor_sizes[0]) \
+                if resp.tensor_sizes else -1
+            with self._queue_lock:
+                jh, self._join_handle = self._join_handle, None
+                self._joined = False
+            if jh is not None:
+                self.handles.mark_done(jh, Status.ok(), None)
+            return
+
+        if resp.response_type == ResponseType.ERROR:
+            for nm in resp.tensor_names:
+                entries = self._get_entries(
+                    Response(response_type=ResponseType.ERROR,
+                             tensor_names=[nm]))
+                for e in entries:
+                    self._release_name(e.name)
+                    if e.handle >= 0:
+                        self.handles.mark_done(
+                            e.handle,
+                            Status.precondition_error(resp.error_message),
+                            None)
+            return
+
+        entries = self._get_entries(resp)
+        op_name = resp.response_type.name
+        self.timeline.start(resp.tensor_names[0], op_name)
+        try:
+            if resp.response_type == ResponseType.ALLREDUCE:
+                results = cpu_backend.allreduce(self, entries, resp)
+            elif resp.response_type == ResponseType.ALLGATHER:
+                results = cpu_backend.allgather(self, entries, resp)
+            elif resp.response_type == ResponseType.BROADCAST:
+                results = cpu_backend.broadcast(self, entries, resp)
+            elif resp.response_type == ResponseType.ALLTOALL:
+                results = cpu_backend.alltoall(self, entries, resp)
+            elif resp.response_type == ResponseType.BARRIER:
+                cpu_backend.barrier(self)
+                results = [None] * len(entries)
+            else:
+                raise RuntimeError(f"bad response type {resp.response_type}")
+            status = Status.ok()
+        except Exception as e:
+            self.log.error("collective %s failed: %r", op_name, e)
+            results = [None] * len(entries)
+            status = Status.unknown_error(str(e))
+        self.timeline.end(resp.tensor_names[0])
+        for e, res in zip(entries, results):
+            self._release_name(e.name)
+            if e.handle >= 0:
+                self.handles.mark_done(e.handle, status, res)
+
+    def _abort(self, reason: str) -> None:
+        self._aborted = True
+        self._shutdown_flag.set()

@@ -1,0 +1,1 @@
+"""Utility subsystems: logging, env parsing, sockets, timeline."""

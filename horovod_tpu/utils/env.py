@@ -1,0 +1,57 @@
+"""Env knob parsing.
+
+Parity: ``horovod/common/utils/env_parser.cc`` + the knob list in
+``common.h:61-87``.  All knobs use the ``HVD_`` prefix; the launcher's CLI
+flags and YAML config map onto these (runner/config_parser.py), mirroring
+the reference's three-layer config system (SURVEY.md §5 config row).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Knob names (reference equivalents in comments).
+FUSION_THRESHOLD = "HVD_FUSION_THRESHOLD"          # HOROVOD_FUSION_THRESHOLD
+CYCLE_TIME = "HVD_CYCLE_TIME"                      # HOROVOD_CYCLE_TIME (ms)
+CACHE_CAPACITY = "HVD_CACHE_CAPACITY"              # HOROVOD_CACHE_CAPACITY
+HIERARCHICAL_ALLREDUCE = "HVD_HIERARCHICAL_ALLREDUCE"
+HIERARCHICAL_ALLGATHER = "HVD_HIERARCHICAL_ALLGATHER"
+TIMELINE = "HVD_TIMELINE"                          # HOROVOD_TIMELINE
+TIMELINE_MARK_CYCLES = "HVD_TIMELINE_MARK_CYCLES"
+STALL_CHECK_DISABLE = "HVD_STALL_CHECK_DISABLE"
+STALL_CHECK_TIME = "HVD_STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME = "HVD_STALL_SHUTDOWN_TIME_SECONDS"
+AUTOTUNE = "HVD_AUTOTUNE"
+AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
+ADASUM_MODE = "HVD_ADASUM_MODE"
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v not in (None, "") else default
+
+
+def get_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def fusion_threshold_bytes() -> int:
+    """Default 64 MB, like the reference (operations.cc fusion threshold)."""
+    return get_int(FUSION_THRESHOLD, 64 * 1024 * 1024)
+
+
+def cycle_time_ms() -> float:
+    """Background-loop cadence; reference default 5 ms (operations.cc:416)."""
+    return get_float(CYCLE_TIME, 5.0)

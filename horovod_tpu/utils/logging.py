@@ -1,0 +1,40 @@
+"""Leveled, rank-prefixed logging.
+
+Parity: ``horovod/common/logging.cc:39-67`` (``LOG(LEVEL, rank)`` macros,
+``HOROVOD_LOG_LEVEL`` / ``HOROVOD_LOG_HIDE_TIME``).  Env knobs here:
+``HVD_LOG_LEVEL`` ∈ {trace, debug, info, warning, error, fatal} and
+``HVD_LOG_HIDE_TIME``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+
+def get_logger(rank: int = -1) -> logging.Logger:
+    name = "horovod_tpu" if rank < 0 else f"horovod_tpu[{rank}]"
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        hide_time = os.environ.get("HVD_LOG_HIDE_TIME", "0") in ("1", "true")
+        fmt = "[%(name)s %(levelname)s] %(message)s" if hide_time else \
+            "%(asctime)s [%(name)s %(levelname)s] %(message)s"
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+        level = os.environ.get("HVD_LOG_LEVEL", "warning").lower()
+        logger.setLevel(_LEVELS.get(level, logging.WARNING))
+        logger.propagate = False
+    return logger

@@ -1,0 +1,68 @@
+"""Framed TCP helpers for the controller and CPU data plane.
+
+The control plane is host-side traffic exactly like the reference's
+(gloo-over-TCP / MPI): tiny framed messages.  Frame = u8 tag, u32 LE length,
+payload.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional, Tuple
+
+HEADER = struct.Struct("<BI")
+
+# Channel tags.
+TAG_REQUEST_LIST = 1
+TAG_RESPONSE_LIST = 2
+TAG_DATA = 3
+TAG_KV = 4
+
+
+def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:
+    sock.sendall(HEADER.pack(tag, len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed connection")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+    hdr = recv_exact(sock, HEADER.size)
+    tag, n = HEADER.unpack(hdr)
+    return tag, recv_exact(sock, n)
+
+
+def listen_on(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, port))
+    s.listen(128)
+    return s
+
+
+def connect_retry(host: str, port: int, timeout: float = 30.0,
+                  interval: float = 0.05) -> socket.socket:
+    import time
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            s = socket.create_connection((host, port), timeout=5.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.settimeout(None)
+            return s
+        except OSError as e:
+            last = e
+            time.sleep(interval)
+    raise ConnectionError(f"cannot connect to {host}:{port}: {last}")

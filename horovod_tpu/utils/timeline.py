@@ -1,0 +1,147 @@
+"""Chrome-tracing timeline profiler.
+
+Parity: ``horovod/common/timeline.cc/.h`` — rank 0 writes a Chrome
+``chrome://tracing`` JSON stream of per-tensor phases: NEGOTIATE_<OP> (with
+per-rank ready ticks), the top-level op, and nested activities (QUEUE,
+MEMCPY_IN_FUSION_BUFFER, <BACKEND>_ALLREDUCE, ...).  Enabled by
+``HVD_TIMELINE=<path>`` (reference: HOROVOD_TIMELINE, operations.cc:392).
+
+Design difference: the reference drains a boost lock-free SPSC queue on a
+dedicated writer thread; here a plain ``queue.SimpleQueue`` + writer thread
+gives the same non-blocking hot path in far less machinery.  The native C++
+core has its own writer (csrc/timeline.cc) with the same file format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+# Canonical activity names (parity: common.h:30-59).
+NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
+NEGOTIATE_ALLGATHER = "NEGOTIATE_ALLGATHER"
+NEGOTIATE_BROADCAST = "NEGOTIATE_BROADCAST"
+NEGOTIATE_ALLTOALL = "NEGOTIATE_ALLTOALL"
+ALLREDUCE = "ALLREDUCE"
+ALLGATHER = "ALLGATHER"
+BROADCAST = "BROADCAST"
+ALLTOALL = "ALLTOALL"
+QUEUE = "QUEUE"
+MEMCPY_IN_FUSION_BUFFER = "MEMCPY_IN_FUSION_BUFFER"
+MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
+CPU_RING_ALLREDUCE = "CPU_RING_ALLREDUCE"
+XLA_ALLREDUCE = "XLA_ALLREDUCE"
+CYCLE_START = "CYCLE_START"
+
+
+class Timeline:
+    """Per-process timeline; no-op unless ``initialize`` is called with a
+    filename (only rank 0 does, like the reference)."""
+
+    def __init__(self):
+        self._q: Optional[queue.SimpleQueue] = None
+        self._writer: Optional[threading.Thread] = None
+        self._f = None
+        self._start_ns = 0
+        self._tensor_tids = {}
+        self._mark_cycles = False
+
+    @property
+    def enabled(self) -> bool:
+        return self._q is not None
+
+    def initialize(self, filename: str, mark_cycles: bool = False) -> None:
+        if self.enabled or not filename:
+            return
+        self._f = open(filename, "w")
+        self._f.write("[\n")
+        self._start_ns = time.monotonic_ns()
+        self._mark_cycles = mark_cycles
+        self._q = queue.SimpleQueue()
+        self._writer = threading.Thread(
+            target=self._drain, name="hvd-timeline", daemon=True)
+        self._writer.start()
+
+    def shutdown(self) -> None:
+        if not self.enabled:
+            return
+        self._q.put(None)
+        self._writer.join(timeout=5)
+        try:
+            self._f.close()
+        except Exception:
+            pass
+        self._q = None
+
+    # -- event emission (hot path: enqueue only) --------------------------
+
+    def _ts_us(self) -> float:
+        return (time.monotonic_ns() - self._start_ns) / 1e3
+
+    def _tid(self, tensor_name: str) -> int:
+        if tensor_name not in self._tensor_tids:
+            self._tensor_tids[tensor_name] = len(self._tensor_tids) + 1
+        return self._tensor_tids[tensor_name]
+
+    def _emit(self, ph, name, tensor_name, args=None):
+        if not self.enabled:
+            return
+        ev = {
+            "ph": ph,
+            "ts": self._ts_us(),
+            "pid": 0,
+            "tid": self._tid(tensor_name) if tensor_name else 0,
+        }
+        if name is not None:
+            ev["name"] = name
+        if args:
+            ev["args"] = args
+        self._q.put(ev)
+
+    def negotiate_start(self, tensor_name: str, op_name: str) -> None:
+        self._emit("B", f"NEGOTIATE_{op_name}", tensor_name)
+
+    def negotiate_rank_ready(self, tensor_name: str, rank: int) -> None:
+        self._emit("i", f"RANK_{rank}_READY", tensor_name)
+
+    def negotiate_end(self, tensor_name: str) -> None:
+        self._emit("E", None, tensor_name)
+
+    def start(self, tensor_name: str, op_name: str) -> None:
+        self._emit("B", op_name, tensor_name)
+
+    def activity_start(self, tensor_name: str, activity: str) -> None:
+        self._emit("B", activity, tensor_name)
+
+    def activity_end(self, tensor_name: str) -> None:
+        self._emit("E", None, tensor_name)
+
+    def end(self, tensor_name: str) -> None:
+        self._emit("E", None, tensor_name)
+
+    def mark_cycle_start(self) -> None:
+        if self._mark_cycles:
+            self._emit("i", CYCLE_START, "")
+
+    # -- writer thread ----------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                break
+            self._f.write(json.dumps(ev) + ",\n")
+            self._f.flush()
+
+
+def from_env(rank: int) -> Timeline:
+    t = Timeline()
+    path = os.environ.get("HVD_TIMELINE", "")
+    if path and rank == 0:
+        t.initialize(path, mark_cycles=os.environ.get(
+            "HVD_TIMELINE_MARK_CYCLES", "0") in ("1", "true"))
+    return t

@@ -1,0 +1,7 @@
+"""Version of the horovod_tpu framework.
+
+Capability target: Horovod v0.19.1 (reference: /root/reference,
+``horovod/__init__.py:1``) rebuilt TPU-native.
+"""
+
+__version__ = "0.1.0"

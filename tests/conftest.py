@@ -1,0 +1,52 @@
+"""Test fixtures for horovod_tpu.
+
+Multi-chip behavior is tested on a virtual 8-device CPU mesh: the env vars
+below MUST be set before the first ``import jax`` anywhere in the test
+process, which is why they live at the top of conftest instead of inside a
+fixture.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the same op-semantics
+tests run single-process and N-way; multi-process ("multi-node on one host")
+tests spawn subprocesses through the launcher, exactly like the reference
+wraps each pytest file in ``horovodrun -np 2 -H localhost:2``.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment's sitecustomize registers the `axon` TPU-tunnel PJRT
+# plugin and force-selects it via jax.config (overriding JAX_PLATFORMS).
+# Tests must run on the virtual CPU mesh, so force the config back before
+# any backend initializes.
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def jax():
+    import jax as _jax
+
+    return _jax
+
+
+@pytest.fixture(scope="session")
+def eight_devices(jax):
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return devs[:8]
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

@@ -1,0 +1,182 @@
+"""Worker script for multi-process eager tests.
+
+Run as: python tests/eager_worker.py <scenario>
+with HVD_RANK/HVD_SIZE/HVD_RENDEZVOUS_* env set by the test (or the
+launcher).  Mirrors the reference's strategy of running the same op tests
+under a 2-process launcher (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.ops.adasum import adasum_reduce_numpy  # noqa: E402
+
+
+def scenario_allreduce():
+    rank, size = hvd.rank(), hvd.size()
+    for dtype in (np.float32, np.float64, np.int32, np.int64, np.float16):
+        x = (np.arange(17, dtype=np.float64) * (rank + 1)).astype(dtype)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.{np.dtype(dtype).name}")
+        expect = (np.arange(17, dtype=np.float64) *
+                  sum(r + 1 for r in range(size))).astype(dtype)
+        np.testing.assert_allclose(
+            out.astype(np.float64), expect.astype(np.float64),
+            rtol=1e-2 if dtype == np.float16 else 1e-6)
+    # average
+    x = np.full((5, 3), float(rank), np.float32)
+    out = hvd.allreduce(x, op=hvd.Average, name="ar.avg")
+    np.testing.assert_allclose(out, np.full((5, 3), (size - 1) / 2.0),
+                               rtol=1e-6)
+    # min/max/product
+    x = np.array([rank + 1.0], np.float32)
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Min, name="ar.min"), [1.0])
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Max, name="ar.max"), [float(size)])
+    np.testing.assert_allclose(
+        hvd.allreduce(x, op=hvd.Product, name="ar.prod"),
+        [float(np.prod([r + 1.0 for r in range(size)]))])
+    # prescale/postscale
+    x = np.ones(4, np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, name="ar.scale",
+                        prescale_factor=2.0, postscale_factor=0.5)
+    np.testing.assert_allclose(out, np.full(4, float(size)), rtol=1e-6)
+    # bfloat16
+    import ml_dtypes
+
+    x = np.ones(8, ml_dtypes.bfloat16) * (rank + 1)
+    out = hvd.allreduce(x, op=hvd.Sum, name="ar.bf16")
+    np.testing.assert_allclose(
+        out.astype(np.float64), np.full(8, sum(r + 1.0 for r in range(size))))
+
+
+def scenario_fusion():
+    # Many small tensors submitted together: exercises controller fusion.
+    rank, size = hvd.rank(), hvd.size()
+    handles = [hvd.allreduce_async(
+        np.full(64, rank + i, np.float32), name=f"fuse.{i}", op=hvd.Sum)
+        for i in range(32)]
+    for i, h in enumerate(handles):
+        out = hvd.synchronize(h)
+        expect = np.full(64, sum(r + i for r in range(size)), np.float32)
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def scenario_allgather():
+    rank, size = hvd.rank(), hvd.size()
+    # ragged first dims: rank r contributes r+1 rows
+    x = np.full((rank + 1, 3), float(rank), np.float32)
+    out = hvd.allgather(x, name="ag.ragged")
+    expect = np.concatenate(
+        [np.full((r + 1, 3), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(out, expect)
+    # 1-D
+    x = np.arange(4, dtype=np.int32) + rank * 10
+    out = hvd.allgather(x, name="ag.1d")
+    expect = np.concatenate(
+        [np.arange(4, dtype=np.int32) + r * 10 for r in range(size)])
+    np.testing.assert_array_equal(out, expect)
+
+
+def scenario_broadcast():
+    rank, size = hvd.rank(), hvd.size()
+    for root in range(size):
+        x = np.full((2, 2), float(rank + 1), np.float32)
+        out = hvd.broadcast(x, root_rank=root, name=f"bc.{root}")
+        np.testing.assert_allclose(out, np.full((2, 2), float(root + 1)))
+    obj = hvd.broadcast_object(
+        {"answer": 42, "rank": rank} if rank == 1 else None, root_rank=1)
+    assert obj == {"answer": 42, "rank": 1}, obj
+
+
+def scenario_alltoall():
+    rank, size = hvd.rank(), hvd.size()
+    # equal splits: rank r sends [r*size + j] to rank j
+    x = np.arange(size, dtype=np.float32) + rank * size
+    out = hvd.alltoall(x, name="a2a.eq")
+    if isinstance(out, tuple):
+        out, recv_splits = out
+        assert list(recv_splits) == [1] * size
+    expect = np.array([r * size + rank for r in range(size)], np.float32)
+    np.testing.assert_allclose(out, expect)
+    # ragged splits: rank r sends j+1 rows to rank j
+    splits = [j + 1 for j in range(size)]
+    x = np.full((sum(splits), 2), float(rank), np.float32)
+    out, recv_splits = hvd.alltoall(x, splits=splits, name="a2a.ragged")
+    assert list(recv_splits) == [rank + 1] * size
+    expect = np.concatenate(
+        [np.full((rank + 1, 2), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(out, expect)
+
+
+def scenario_adasum():
+    rank, size = hvd.rank(), hvd.size()
+    rng = np.random.RandomState(42)
+    all_grads = [rng.randn(31).astype(np.float32) for _ in range(size)]
+    out = hvd.allreduce(all_grads[rank], op=hvd.Adasum, name="adasum.0")
+    expect = adasum_reduce_numpy(all_grads)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def scenario_join():
+    rank, size = hvd.rank(), hvd.size()
+    # rank r has r+1 batches; ranks keep allreducing until out of data.
+    batches = rank + 1
+    total = np.zeros(4, np.float32)
+    for b in range(batches):
+        total = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                              name=f"join.step{b}")
+    last = hvd.join()
+    assert last == size - 1, f"last joined {last}"
+    # after join everyone agrees the slowest rank's last step summed only
+    # the ranks that still had data
+    if rank == size - 1:
+        np.testing.assert_allclose(total, np.ones(4) * 1.0)
+
+
+def scenario_barrier():
+    for _ in range(3):
+        hvd.barrier()
+
+
+def scenario_error_mismatch():
+    rank, size = hvd.rank(), hvd.size()
+    # mismatched shapes must produce an error on every rank
+    x = np.ones(3 + rank, np.float32)
+    try:
+        hvd.allreduce(x, op=hvd.Sum, name="bad.shape")
+    except RuntimeError as e:
+        assert "Mismatched" in str(e), e
+    else:
+        raise AssertionError("expected shape-mismatch error")
+    # engine still works afterwards
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="good")
+    np.testing.assert_allclose(out, np.full(2, float(size)))
+
+
+def scenario_timeline():
+    rank, size = hvd.rank(), hvd.size()
+    hvd.allreduce(np.ones(4, np.float32), name="tl.tensor", op=hvd.Sum)
+    hvd.barrier()
+
+
+SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
+             if k.startswith("scenario_")}
+
+
+def main():
+    name = sys.argv[1]
+    hvd.init()
+    try:
+        SCENARIOS[name]()
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

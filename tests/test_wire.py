@@ -1,0 +1,75 @@
+"""Wire codec + core type tests (no devices needed)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import wire
+from horovod_tpu.common.types import (
+    DataType,
+    ReduceOp,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+    TensorShape,
+    dtype_from_numpy,
+)
+
+
+def test_tensor_shape():
+    s = TensorShape([2, 3, 4])
+    assert s.num_elements == 24
+    assert s.rank == 3
+    assert str(s) == "[2, 3, 4]"
+    assert TensorShape([2, 3, 4]) == TensorShape((2, 3, 4))
+    assert TensorShape([]) != TensorShape([1])
+
+
+def test_dtype_mapping():
+    assert dtype_from_numpy(np.dtype(np.float32)) == DataType.FLOAT32
+    assert dtype_from_numpy(np.dtype(np.int64)) == DataType.INT64
+    import ml_dtypes
+
+    assert dtype_from_numpy(np.dtype(ml_dtypes.bfloat16)) == \
+        DataType.BFLOAT16
+    assert DataType.BFLOAT16.itemsize == 2
+    with pytest.raises(ValueError):
+        dtype_from_numpy(np.dtype(np.complex64))
+
+
+def test_request_roundtrip():
+    reqs = [
+        Request(request_rank=3, request_type=RequestType.ALLREDUCE,
+                tensor_type=DataType.BFLOAT16, tensor_name="layer1/w:grad",
+                device="tpu:0", tensor_shape=TensorShape([128, 256]),
+                reduce_op=ReduceOp.ADASUM, prescale_factor=0.5,
+                postscale_factor=2.0),
+        Request(request_rank=0, request_type=RequestType.BROADCAST,
+                tensor_name="π-名前", root_rank=2,
+                tensor_shape=TensorShape([])),
+    ]
+    data = wire.encode_request_list(reqs, shutdown=True)
+    out, shutdown = wire.decode_request_list(data)
+    assert shutdown is True
+    assert out == reqs
+
+
+def test_response_roundtrip():
+    resps = [
+        Response(response_type=ResponseType.ALLREDUCE,
+                 tensor_names=["a", "b"], tensor_type=DataType.FLOAT32,
+                 devices=["cpu"], tensor_sizes=[10, 20]),
+        Response(response_type=ResponseType.ERROR,
+                 tensor_names=["x"], error_message="shape mismatch"),
+    ]
+    data = wire.encode_response_list(resps, shutdown=False)
+    out, shutdown = wire.decode_response_list(data)
+    assert shutdown is False
+    assert out == resps
+
+
+def test_empty_lists():
+    reqs, sd = wire.decode_request_list(wire.encode_request_list([]))
+    assert reqs == [] and sd is False
+    resps, sd = wire.decode_response_list(wire.encode_response_list([]))
+    assert resps == [] and sd is False
