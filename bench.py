@@ -1,0 +1,82 @@
+"""Benchmark: ResNet-50 synthetic images/sec — the reference's headline
+metric (``examples/tensorflow2_synthetic_benchmark.py``: ResNet-50, batch
+32, images/sec per device, mean over timed iterations after warmup).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the reference's only published per-device
+throughput: 1656.82 images/sec on 16 Pascal GPUs (docs/benchmarks.rst:28-42)
+= 103.55 images/sec/device — ResNet-101 there, ResNet-50 here, so the ratio
+is indicative, not apples-to-apples; BASELINE.json publishes no ResNet-50
+number.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import resnet
+    from horovod_tpu.parallel import mesh as mesh_mod
+    from horovod_tpu.parallel import train as train_mod
+
+    batch = 32
+    warmup_iters = 3
+    iters = 10
+    batches_per_iter = 10
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if not on_tpu:
+        # CPU fallback (CI): tiny model so the line still prints quickly.
+        cfg = resnet.ResNetConfig(blocks=(1, 1, 1, 1), width=8,
+                                  num_classes=100,
+                                  compute_dtype=jnp.float32)
+        batch, warmup_iters, iters, batches_per_iter = 8, 1, 3, 2
+    else:
+        cfg = resnet.resnet50_config()
+
+    mesh = mesh_mod.make_mesh({"dp": 1}, devices=devices[:1])
+    step, init = train_mod.make_resnet_train_step(
+        cfg, mesh, optax.sgd(0.01, momentum=0.9))
+    state = init(jax.random.PRNGKey(0))
+
+    rs = np.random.RandomState(0)
+    size = 224 if on_tpu else 32
+    images = jnp.asarray(rs.rand(batch, size, size, 3), jnp.float32)
+    labels = jnp.asarray(rs.randint(0, cfg.num_classes, (batch,)))
+
+    for _ in range(warmup_iters):
+        state, loss = step(state, images, labels)
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(batches_per_iter):
+            state, loss = step(state, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(batch * batches_per_iter / dt)
+
+    value = float(np.mean(img_secs))
+    baseline = 1656.82 / 16.0  # reference's per-device number
+    print(json.dumps({
+        "metric": "resnet50_synthetic_images_per_sec_per_chip"
+                  if on_tpu else "resnet_tiny_cpu_images_per_sec",
+        "value": round(value, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

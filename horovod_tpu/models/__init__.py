@@ -1,6 +1,15 @@
-"""Model zoo for benchmarks and parity configs.
+"""Model zoo: benchmark-parity models (ResNet family, MNIST convnet — the
+reference's example/benchmark configs, SURVEY.md §6) plus the flagship
+multi-axis-parallel Transformer LM for the long-context path.  All pure
+functional JAX: ``init`` returns param pytrees, ``apply``/``loss_fn`` are
+jit-compatible pure functions."""
 
-Mirrors the reference's benchmark surface (SURVEY.md §6: ResNet-50
-synthetic benchmark, MNIST examples) plus a transformer for the
-long-context / sequence-parallel path.
-"""
+from horovod_tpu.models import mnist, resnet, transformer  # noqa: F401
+from horovod_tpu.models.resnet import (  # noqa: F401
+    ResNetConfig,
+    resnet18_config,
+    resnet50_config,
+    resnet101_config,
+    resnet152_config,
+)
+from horovod_tpu.models.transformer import TransformerConfig  # noqa: F401

@@ -1,0 +1,66 @@
+"""Small MNIST convnet — the parity twin of the reference's MNIST examples
+(``examples/tensorflow2_mnist.py``, ``examples/pytorch_mnist.py``: two convs
++ two dense layers).  Functional JAX, bf16 compute / fp32 params."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+def init(rng) -> Params:
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def conv(key, kh, kw, cin, cout):
+        std = math.sqrt(2.0 / (kh * kw * cout))
+        return jax.random.normal(key, (kh, kw, cin, cout),
+                                 jnp.float32) * std
+
+    def dense(key, fin, fout):
+        std = math.sqrt(2.0 / fin)
+        return jax.random.normal(key, (fin, fout), jnp.float32) * std
+
+    return {
+        "conv1": conv(k1, 3, 3, 1, 32),
+        "b1": jnp.zeros((32,), jnp.float32),
+        "conv2": conv(k2, 3, 3, 32, 64),
+        "b2": jnp.zeros((64,), jnp.float32),
+        "fc1": dense(k3, 7 * 7 * 64, 128),
+        "fb1": jnp.zeros((128,), jnp.float32),
+        "fc2": dense(k4, 128, 10),
+        "fb2": jnp.zeros((10,), jnp.float32),
+    }
+
+
+def apply(params: Params, images, dtype=jnp.bfloat16):
+    """``images``: [N, 28, 28, 1] float in [0, 1].  Returns fp32 logits."""
+    x = images.astype(dtype)
+
+    def conv(x, w, stride=1):
+        return lax.conv_general_dilated(
+            x, w.astype(dtype), (stride, stride),
+            [(1, 1), (1, 1)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    x = jax.nn.relu(conv(x, params["conv1"]) + params["b1"].astype(dtype))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1),
+                          (1, 2, 2, 1), "VALID")
+    x = jax.nn.relu(conv(x, params["conv2"]) + params["b2"].astype(dtype))
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 2, 2, 1),
+                          (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"].astype(dtype)
+                    + params["fb1"].astype(dtype))
+    logits = (x.astype(jnp.float32) @ params["fc2"] + params["fb2"])
+    return logits
+
+
+def loss_fn(params: Params, images, labels) -> jnp.ndarray:
+    logits = apply(params, images)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))

@@ -1,0 +1,285 @@
+"""Decoder-only Transformer LM — the flagship multi-axis-parallel model.
+
+The reference framework is data-parallel only (SURVEY.md §2.8); a complete
+TPU framework must also scale model size (tp), sequence length (sp), and
+experts (ep).  This model is built so that every one of those axes is a
+*sharding decision*, not a code path:
+
+* Layers are stacked along a leading axis and iterated with ``lax.scan`` —
+  one compiled layer body regardless of depth (and the natural substrate
+  for pipeline parallelism: split the stacked axis over the ``pp`` mesh
+  axis, see ``horovod_tpu.parallel.pipeline``).
+* ``param_specs(config)`` gives a PartitionSpec pytree: attention heads and
+  FFN hidden dim sharded over ``tp`` (Megatron layout: column-parallel in,
+  row-parallel out — XLA inserts exactly the two psums per block), experts
+  over ``ep``.
+* Activations carry ``P('dp', 'sp', None)`` constraints: batch over data
+  ranks, sequence over the sp axis.  Attention under GSPMD all-gathers K/V
+  over sp; the ring-attention path (``horovod_tpu.parallel.ring_attention``)
+  replaces that with neighbor ``ppermute`` exchanges when activated.
+* bf16 compute, fp32 params/norms, RoPE positions, pre-RMSNorm blocks,
+  causal masking via static ``lax`` ops only — no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    n_experts: int = 0          # 0 → dense FFN; >0 → Switch-style MoE
+    capacity_factor: float = 1.25
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def _normal(key, shape, std):
+    return jax.random.normal(key, shape, jnp.float32) * std
+
+
+def init(rng, cfg: TransformerConfig) -> Params:
+    k = iter(jax.random.split(rng, 16))
+    L, D, H, HD, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                      cfg.head_dim, cfg.d_ff)
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+    layer: Params = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+        "wq": _normal(next(k), (L, D, H, HD), std),
+        "wk": _normal(next(k), (L, D, H, HD), std),
+        "wv": _normal(next(k), (L, D, H, HD), std),
+        "wo": _normal(next(k), (L, H, HD, D), out_std),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layer["router"] = _normal(next(k), (L, D, E), std)
+        layer["w_in"] = _normal(next(k), (L, E, D, F), std)
+        layer["w_gate"] = _normal(next(k), (L, E, D, F), std)
+        layer["w_out"] = _normal(next(k), (L, E, F, D), out_std)
+    else:
+        layer["w_in"] = _normal(next(k), (L, D, F), std)
+        layer["w_gate"] = _normal(next(k), (L, D, F), std)
+        layer["w_out"] = _normal(next(k), (L, F, D), out_std)
+    return {
+        "embed": _normal(next(k), (cfg.vocab_size, D), std),
+        "layers": layer,
+        "ln_f": jnp.ones((D,), jnp.float32),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """PartitionSpec pytree (Megatron tp layout + ep experts).
+
+    The leading stacked-layer axis is left unsharded here; the pipeline
+    wrapper reshards it over ``pp`` when pipelining is on.
+    """
+    layer: Params = {
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+        "wq": P(None, None, "tp", None),
+        "wk": P(None, None, "tp", None),
+        "wv": P(None, None, "tp", None),
+        "wo": P(None, "tp", None, None),
+    }
+    if cfg.n_experts:
+        layer["router"] = P(None, None, None)
+        layer["w_in"] = P(None, "ep", None, "tp")
+        layer["w_gate"] = P(None, "ep", None, "tp")
+        layer["w_out"] = P(None, "ep", "tp", None)
+    else:
+        layer["w_in"] = P(None, None, "tp")
+        layer["w_gate"] = P(None, None, "tp")
+        layer["w_out"] = P(None, "tp", None)
+    return {
+        "embed": P("tp", None),
+        "layers": layer,
+        "ln_f": P(None),
+    }
+
+
+ACT_SPEC = P("dp", "sp", None)  # [batch, seq, d_model]
+
+
+# ---------------------------------------------------------------------------
+# apply
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x, spec: Optional[P], mesh):
+    """Apply a sharding constraint, keeping only axes present in ``mesh``.
+
+    ``mesh`` is threaded explicitly (static Python value) instead of read
+    from ambient context so the model works under plain ``jit`` with
+    ``in_shardings`` on any JAX version.
+    """
+    if spec is None or mesh is None:
+        return x
+    from horovod_tpu.parallel.mesh import filter_spec
+
+    fixed = filter_spec(spec, mesh)
+    if all(ax is None for ax in fixed):
+        return x
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, fixed))
+
+
+def _rmsnorm(x, g):
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (y * g).astype(x.dtype)
+
+
+def _rope(x, theta: float):
+    """Rotary embedding over head_dim pairs; x: [B, S, H, HD]."""
+    B, S, H, HD = x.shape
+    half = HD // 2
+    freqs = jnp.exp(
+        -math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    pos = jnp.arange(S, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]          # [S, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _attention(x, lp, cfg: TransformerConfig):
+    B, S, D = x.shape
+    dtype = cfg.compute_dtype
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dtype))
+    kk = jnp.einsum("bsd,dhk->bshk", x, lp["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dtype))
+    q = _rope(q, cfg.rope_theta)
+    kk = _rope(kk, cfg.rope_theta)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, kk).astype(jnp.float32)
+    logits *= scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+    return jnp.einsum("bshk,hkd->bsd", ctx, lp["wo"].astype(dtype))
+
+
+def _dense_ffn(x, lp, dtype):
+    h = jnp.einsum("bsd,df->bsf", x, lp["w_in"].astype(dtype))
+    g = jnp.einsum("bsd,df->bsf", x, lp["w_gate"].astype(dtype))
+    h = h * jax.nn.silu(g)
+    return jnp.einsum("bsf,fd->bsd", h, lp["w_out"].astype(dtype))
+
+
+def _moe_ffn(x, lp, cfg: TransformerConfig):
+    """Switch-style top-1 MoE with static capacity.
+
+    Dispatch/combine are dense einsums against one-hot masks — fully static
+    shapes, so XLA shards the expert dimension over ``ep`` and turns the
+    einsums into all-to-alls.  Re-derivation of the standard Switch layer
+    (public Mesh-TF/Flaxformer pattern), not a port.
+    """
+    B, S, D = x.shape
+    E = cfg.n_experts
+    dtype = cfg.compute_dtype
+    C = max(1, int(cfg.capacity_factor * S * B / E))
+
+    xf = x.reshape(B * S, D)
+    router_logits = (xf.astype(jnp.float32)
+                     @ lp["router"].astype(jnp.float32))      # [T, E]
+    gates = jax.nn.softmax(router_logits, axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)                    # [T]
+    gate = jnp.max(gates, axis=-1)                             # [T]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [T, E]
+    # Position of each token within its expert's capacity buffer.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0            # [T, E]
+    keep = (pos < C) & (onehot > 0)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                            dtype=jnp.float32) * keep[..., None]
+    dispatch = pos_oh                                           # [T, E, C]
+    combine = dispatch * gate[:, None, None]                    # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), xf)  # [E, C, D]
+    h = jnp.einsum("ecd,edf->ecf", xe, lp["w_in"].astype(dtype))
+    g = jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"].astype(dtype))
+    h = h * jax.nn.silu(g)
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w_out"].astype(dtype))
+    y = jnp.einsum("tec,ecd->td", combine.astype(dtype), ye)
+    # Auxiliary load-balancing loss (Switch eq. 4).
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(density * density_proxy)
+    return y.reshape(B, S, D), aux
+
+
+def _layer(x, lp, cfg: TransformerConfig, mesh):
+    y = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg)
+    x = _constrain(x + y, ACT_SPEC, mesh)
+    h = _rmsnorm(x, lp["ln2"])
+    if cfg.n_experts:
+        y, aux = _moe_ffn(h, lp, cfg)
+    else:
+        y, aux = _dense_ffn(h, lp, cfg.compute_dtype), 0.0
+    x = _constrain(x + y, ACT_SPEC, mesh)
+    return x, aux
+
+
+def apply(params: Params, tokens, cfg: TransformerConfig,
+          *, mesh=None, remat: bool = True):
+    """Forward pass.  ``tokens``: [B, S] int32.  Returns
+    ``(logits_fp32, aux_loss)``."""
+    dtype = cfg.compute_dtype
+    x = params["embed"].astype(dtype)[tokens]
+    x = _constrain(x, ACT_SPEC, mesh)
+
+    layer_fn = _layer
+    if remat:
+        layer_fn = jax.checkpoint(_layer, static_argnums=(2, 3))
+
+    def body(carry, lp):
+        h, aux_sum = carry
+        h, aux = layer_fn(h, lp, cfg, mesh)
+        return (h, aux_sum + aux), None
+
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                           params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        params["embed"])
+    return logits, aux
+
+
+def loss_fn(params, tokens, targets, cfg: TransformerConfig,
+            *, mesh=None, aux_weight: float = 0.01):
+    logits, aux = apply(params, tokens, cfg, mesh=mesh)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.mean(jnp.take_along_axis(logp, targets[..., None],
+                                        axis=-1))
+    return nll + aux_weight * aux

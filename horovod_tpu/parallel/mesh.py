@@ -159,6 +159,26 @@ def mesh_axis_size(mesh, name: str) -> int:
     return mesh.shape.get(name, 1)
 
 
+def filter_spec(spec, mesh):
+    """Drop PartitionSpec axes that are not in ``mesh`` (→ None).
+
+    Lets models annotate the full axis vocabulary (dp/tp/sp/ep/…) while
+    running on meshes that carry any subset.  Handles tuple entries
+    (sharding one dim over several axes) by filtering within the tuple.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def keep(ax):
+        if ax is None:
+            return None
+        if isinstance(ax, (tuple, list)):
+            kept = tuple(a for a in ax if a in mesh.shape)
+            return kept if kept else None
+        return ax if ax in mesh.shape else None
+
+    return P(*[keep(ax) for ax in spec])
+
+
 def data_parallel_axes(mesh) -> Tuple[str, ...]:
     """Axes that carry gradient reduction: every mesh axis that is a
     replication axis for parameters (dp, dcn and ep-for-non-expert params
