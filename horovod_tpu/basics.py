@@ -49,17 +49,15 @@ def _discover(rank, size, local_rank, local_size, cross_rank, cross_size):
         cross_rank = int(env.get(_Env.CROSS_RANK, "0"))
         cross_size = int(env.get(_Env.CROSS_SIZE, "1"))
     if size is None:
-        # JAX multi-host (TPU pod) metadata, if initialized.
-        try:
-            import jax
+        # TPU pod / JAX multi-host metadata (runner/discovery.py): slice
+        # coordinates become the local/cross split the controller uses.
+        from horovod_tpu.runner import discovery
 
-            if jax.process_count() > 1:
-                rank = jax.process_index()
-                size = jax.process_count()
-                local_rank, local_size = 0, 1
-                cross_rank, cross_size = rank, size
-        except Exception:
-            pass
+        topo = discovery.discover()
+        if topo is not None:
+            rank, size = topo.rank, topo.size
+            local_rank, local_size = topo.local_rank, topo.local_size
+            cross_rank, cross_size = topo.cross_rank, topo.cross_size
     if size is None:
         rank, size = 0, 1
     if local_size is None:

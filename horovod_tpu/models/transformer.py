@@ -47,6 +47,10 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     compute_dtype: Any = jnp.bfloat16
+    # "dense": GSPMD attention (XLA all-gathers K/V over sp);
+    # "ring": blockwise ring attention via ppermute over the sp ring;
+    # "ulysses": all-to-all head exchange.  See parallel/ring_attention.py.
+    attn_impl: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -173,7 +177,7 @@ def _rope(x, theta: float):
     ).astype(x.dtype)
 
 
-def _attention(x, lp, cfg: TransformerConfig):
+def _attention(x, lp, cfg: TransformerConfig, mesh=None):
     B, S, D = x.shape
     dtype = cfg.compute_dtype
     q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dtype))
@@ -181,13 +185,28 @@ def _attention(x, lp, cfg: TransformerConfig):
     v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dtype))
     q = _rope(q, cfg.rope_theta)
     kk = _rope(kk, cfg.rope_theta)
-    scale = 1.0 / math.sqrt(cfg.head_dim)
-    logits = jnp.einsum("bshk,bthk->bhst", q, kk).astype(jnp.float32)
-    logits *= scale
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-    logits = jnp.where(mask[None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
-    ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+
+    if cfg.attn_impl not in ("dense", "ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl must be dense/ring/ulysses, got {cfg.attn_impl!r}")
+    use_sp = (cfg.attn_impl != "dense" and mesh is not None
+              and mesh.shape.get("sp", 1) > 1)
+    if use_sp:
+        # Sequence-parallel attention: K/V never gather; blocks rotate the
+        # sp ring (ring) or heads exchange via all-to-all (ulysses).
+        from horovod_tpu.parallel import ring_attention as ra
+
+        ctx = ra.make_sharded_attention(
+            mesh, impl=cfg.attn_impl, axis="sp", causal=True,
+            head_axis="tp")(q, kk, v)
+    else:
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        logits = jnp.einsum("bshk,bthk->bhst", q, kk).astype(jnp.float32)
+        logits *= scale
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+        ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
     return jnp.einsum("bshk,hkd->bsd", ctx, lp["wo"].astype(dtype))
 
 
@@ -240,7 +259,7 @@ def _moe_ffn(x, lp, cfg: TransformerConfig):
 
 
 def _layer(x, lp, cfg: TransformerConfig, mesh):
-    y = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg)
+    y = _attention(_rmsnorm(x, lp["ln1"]), lp, cfg, mesh)
     x = _constrain(x + y, ACT_SPEC, mesh)
     h = _rmsnorm(x, lp["ln2"])
     if cfg.n_experts:

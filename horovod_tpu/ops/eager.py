@@ -98,13 +98,30 @@ def synchronize(handle: int):
     return fn(raw) if fn else raw
 
 
-def allreduce_async(tensor, name: Optional[str] = None,
-                    op: ReduceOp = ReduceOp.AVERAGE,
+def _resolve_op(op: Optional[ReduceOp], average: Optional[bool]) -> ReduceOp:
+    """Reconcile the modern ``op=`` arg with the classic ``average=`` flag
+    (horovod 0.19 surface: allreduce(tensor, average=True); op= and
+    average= are mutually exclusive, torch/mpi_ops.py:68-90)."""
+    if average is not None:
+        if op is not None:
+            raise ValueError(
+                "The op parameter supersedes average; pass only one")
+        return ReduceOp.AVERAGE if average else ReduceOp.SUM
+    return ReduceOp.AVERAGE if op is None else op
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None,
+                    op: Optional[ReduceOp] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
                     compression=None) -> int:
+    """Positional order matches horovod 0.19 (tensor, average, name) so
+    ported calls like ``allreduce_async(grad, False)`` keep their meaning
+    (torch/mpi_ops.py:94-129)."""
     from horovod_tpu.ops.compression import Compression
 
+    op = _resolve_op(op, average)
     compression = compression or Compression.none
     arr, restore = _to_numpy(tensor)
     # Eager compression operates on numpy: cast down before the wire.
@@ -144,21 +161,25 @@ def _bf16_dtype():
     return np.dtype(ml_dtypes.bfloat16)
 
 
-def allreduce(tensor, name: Optional[str] = None,
-              op: ReduceOp = ReduceOp.AVERAGE,
+def allreduce(tensor, average: Optional[bool] = None,
+              name: Optional[str] = None,
+              op: Optional[ReduceOp] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
               compression=None):
     return synchronize(allreduce_async(
-        tensor, name, op, prescale_factor, postscale_factor, compression))
+        tensor, average, name, op, prescale_factor, postscale_factor,
+        compression))
 
 
-def grouped_allreduce(tensors: List, name: Optional[str] = None,
-                      op: ReduceOp = ReduceOp.AVERAGE) -> List:
+def grouped_allreduce(tensors: List, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[ReduceOp] = None) -> List:
     """Eager grouped allreduce; entries negotiate individually but fuse in
     the controller exactly like individually-submitted tensors do."""
+    op = _resolve_op(op, average)
     base = _auto_name("grouped_allreduce", name)
-    handles = [allreduce_async(t, f"{base}.{i}", op)
+    handles = [allreduce_async(t, name=f"{base}.{i}", op=op)
                for i, t in enumerate(tensors)]
     return [synchronize(h) for h in handles]
 

@@ -1,0 +1,171 @@
+"""Sequence parallelism: ring attention and Ulysses head-exchange.
+
+The reference has no sequence/context parallelism (SURVEY.md §5 long-context
+row: absent; scaling axis is the batch).  A complete TPU framework needs
+long-context support as a first-class citizen, and the ICI torus is built
+for it:
+
+* **Ring attention** (`ring_attention`): K/V blocks rotate around the ``sp``
+  ring via ``lax.ppermute`` (one ICI-neighbor hop per step) while each shard
+  accumulates attention for its local queries with an online-softmax
+  (running max / denominator), fp32 accumulators.  Communication is
+  perfectly overlapped by XLA: the next block transfers while the current
+  one is being used — the TPU-native equivalent of what the reference's
+  background thread + streams did for allreduce overlap.
+* **Ulysses** (`ulysses_attention`): one ``all_to_all`` turns
+  sequence-sharding into head-sharding, full attention runs locally per
+  head group, a second ``all_to_all`` restores sequence-sharding.  Cheaper
+  for moderate sequence lengths; requires ``heads % sp_size == 0``.
+
+Both are written for use inside ``shard_map`` bodies (axis names, like
+``horovod_tpu.ops.collective``); ``make_sharded_attention`` wraps one in
+``shard_map`` over a mesh for direct use.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.shard import shard_map
+
+
+def _online_block(q, k, v, m, l, acc, mask, scale):
+    """One online-softmax accumulation step.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; acc like q but
+    fp32.  ``mask``: [Sq, Sk] boolean (True = attend) or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -1e30)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + \
+        pv.astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = True):
+    """Blockwise ring attention over the ``axis`` ring (inside shard_map).
+
+    q/k/v: [B, S_local, H, D] — the local sequence shard.  Returns the
+    attention output [B, S_local, H, D] in q's dtype.  Softmax statistics
+    are fp32; the result is exact (not an approximation) — identical to
+    full attention on the gathered sequence, up to fp accumulation order.
+    """
+    n = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # send to next neighbor
+
+    tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def _mask(owner):
+        if not causal:
+            return None
+        # owner < my: attend fully; owner == my: causal triangle;
+        # owner > my: fully masked.  Select via lax to stay traceable.
+        full = jnp.ones((S, S), jnp.bool_)
+        none = jnp.zeros((S, S), jnp.bool_)
+        return lax.select(
+            owner < my, full, lax.select(owner == my, tri, none))
+
+    # Step 0 is the self-block (no hop); steps 1..n-1 each hop K/V one
+    # neighbor before use, so exactly n-1 ppermutes happen in total.
+    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = jnp.zeros((B, S, H, D), jnp.float32)
+    m, l, acc = _online_block(q, k, v, m0, l0, acc0, _mask(my), scale)
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        k_cur = lax.ppermute(k_cur, axis, perm)
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        # After `step` hops we hold the block of rank (my - step) mod n.
+        owner = (my - step) % n
+        m, l, acc = _online_block(q, k_cur, v_cur, m, l, acc,
+                                  _mask(owner), scale)
+        return k_cur, v_cur, m, l, acc
+
+    _, _, m, l, acc = lax.fori_loop(1, n, body, (k, v, m, l, acc))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = True):
+    """Ulysses sequence parallelism: all-to-all head exchange (inside
+    shard_map).  q/k/v: [B, S_local, H, D] with H divisible by the axis
+    size; returns [B, S_local, H, D]."""
+    n = lax.axis_size(axis)
+    B, S, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # [B, S_local, H, D] -> [B, S_global, H/n, D]
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    Sg = qg.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qg, kg).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((Sg, Sg), jnp.bool_))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg)
+    return heads_to_seq(out)
+
+
+def full_attention(q, k, v, causal: bool = True):
+    """Single-device reference attention (the oracle for tests)."""
+    B, S, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def make_sharded_attention(mesh, impl: str = "ring", axis: str = "sp",
+                           causal: bool = True,
+                           head_axis: Optional[str] = None):
+    """Wrap ring/ulysses attention in shard_map over ``mesh``.
+
+    Returns ``fn(q, k, v) -> out`` taking/returning global [B, S, H, D]
+    arrays sequence-sharded over ``axis``, batch over ``dp`` when the mesh
+    has it, and heads over ``head_axis`` when given (tensor parallelism
+    composed with sequence parallelism).
+    """
+    fns = {"ring": ring_attention, "ulysses": ulysses_attention}
+    if impl not in fns:
+        raise ValueError(f"impl must be one of {sorted(fns)}")
+    if head_axis is not None and head_axis not in mesh.shape:
+        head_axis = None
+    inner = functools.partial(fns[impl], axis=axis, causal=causal)
+    batch_ax = "dp" if "dp" in mesh.shape else None
+    spec = P(batch_ax, axis, head_axis, None)
+
+    def fn(q, k, v):
+        return shard_map(inner, mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+    return fn

@@ -1,0 +1,82 @@
+"""CLI-flag / YAML-config → ``HVD_*`` env mapping.
+
+Parity: ``horovod/run/common/util/config_parser.py`` (set_env_from_args)
+and the ``--config-file`` YAML layer (run.py:275,446-451).  Three
+equivalent config layers, later ones winning: raw env < YAML file < CLI
+flags — matching the reference's precedence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from horovod_tpu.utils import env as E
+
+# argparse dest → env var
+_ARG_ENV = {
+    "fusion_threshold_mb": E.FUSION_THRESHOLD,
+    "cycle_time_ms": E.CYCLE_TIME,
+    "cache_capacity": E.CACHE_CAPACITY,
+    "hierarchical_allreduce": E.HIERARCHICAL_ALLREDUCE,
+    "hierarchical_allgather": E.HIERARCHICAL_ALLGATHER,
+    "timeline_filename": E.TIMELINE,
+    "timeline_mark_cycles": E.TIMELINE_MARK_CYCLES,
+    "no_stall_check": E.STALL_CHECK_DISABLE,
+    "stall_warning_time_seconds": E.STALL_CHECK_TIME,
+    "stall_shutdown_time_seconds": E.STALL_SHUTDOWN_TIME,
+    "autotune": E.AUTOTUNE,
+    "autotune_log_file": E.AUTOTUNE_LOG,
+    "adasum_mode": E.ADASUM_MODE,
+    "log_level": "HVD_LOG_LEVEL",
+}
+
+_MB = {"fusion_threshold_mb"}
+_BOOL = {"hierarchical_allreduce", "hierarchical_allgather",
+         "timeline_mark_cycles", "no_stall_check", "autotune"}
+
+
+def _convert(dest: str, v) -> Optional[str]:
+    """One flag value → env string; None when the flag was not set.
+    ``is``-checks so a legitimate 0 (e.g. --cache-capacity 0) survives."""
+    if v is None or v is False:
+        return None
+    if dest in _BOOL:
+        return "1"
+    if dest in _MB:
+        return str(int(float(v) * 1024 * 1024))
+    return str(v)
+
+
+def env_from_args(args) -> Dict[str, str]:
+    """Collect env assignments from parsed argparse flags (only flags the
+    user actually set — unset flags are skipped so they don't override the
+    YAML/env layers)."""
+    out: Dict[str, str] = {}
+    for dest, env_name in _ARG_ENV.items():
+        s = _convert(dest, getattr(args, dest, None))
+        if s is not None:
+            out[env_name] = s
+    # --disable-cache is the CLI spelling of cache capacity 0 (parity:
+    # config_parser.py maps it the same way in the reference).
+    if getattr(args, "disable_cache", False):
+        out[E.CACHE_CAPACITY] = "0"
+    return out
+
+
+def env_from_config_file(path: str) -> Dict[str, str]:
+    """YAML config: top-level keys are the argparse dests (dashes or
+    underscores), e.g. ``fusion-threshold-mb: 32``."""
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    out: Dict[str, str] = {}
+    for key, v in cfg.items():
+        dest = key.replace("-", "_")
+        env_name = _ARG_ENV.get(dest)
+        if env_name is None:
+            raise ValueError(f"unknown config key {key!r}")
+        s = _convert(dest, v)
+        if s is not None:
+            out[env_name] = s
+    return out

@@ -1,0 +1,71 @@
+"""TPU pod topology discovery.
+
+The reference learns world topology from MPI or launcher-injected env
+(``HOROVOD_RANK``, gloo_context.cc:44-49).  On TPU pods the runtime itself
+knows the topology: each host process belongs to a slice with a bounded
+set of chips.  This module turns that metadata into the same
+rank/local/cross coordinates the controller uses, with no ssh or env
+injection needed.
+
+Sources, in priority order:
+1. ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` (GCE TPU VM metadata, set
+   on every TPU VM worker),
+2. ``MEGASCALE_SLICE_ID`` / ``MEGASCALE_NUM_SLICES`` for multislice (the
+   DCN/cross axis),
+3. an initialized ``jax.distributed`` runtime (process_index/count).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class PodTopology:
+    rank: int            # host process index in the whole job
+    size: int            # total host processes
+    local_rank: int      # index within the slice
+    local_size: int      # hosts per slice
+    cross_rank: int      # slice index (DCN coordinate)
+    cross_size: int      # number of slices
+
+
+def from_tpu_metadata() -> Optional[PodTopology]:
+    """Build topology from TPU VM env metadata; None when not on a pod."""
+    env = os.environ
+    worker_id = env.get("TPU_WORKER_ID")
+    hostnames = env.get("TPU_WORKER_HOSTNAMES")
+    if worker_id is None or hostnames is None:
+        return None
+    local_rank = int(worker_id)
+    local_size = len([h for h in hostnames.split(",") if h.strip()])
+    cross_rank = int(env.get("MEGASCALE_SLICE_ID", "0"))
+    cross_size = int(env.get("MEGASCALE_NUM_SLICES", "1"))
+    return PodTopology(
+        rank=cross_rank * local_size + local_rank,
+        size=cross_size * local_size,
+        local_rank=local_rank,
+        local_size=local_size,
+        cross_rank=cross_rank,
+        cross_size=cross_size,
+    )
+
+
+def from_jax_distributed() -> Optional[PodTopology]:
+    try:
+        import jax
+
+        n = jax.process_count()
+    except Exception:
+        return None
+    if n <= 1:
+        return None
+    r = jax.process_index()
+    return PodTopology(rank=r, size=n, local_rank=0, local_size=1,
+                       cross_rank=r, cross_size=n)
+
+
+def discover() -> Optional[PodTopology]:
+    return from_tpu_metadata() or from_jax_distributed()

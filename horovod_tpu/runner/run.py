@@ -1,0 +1,237 @@
+"""``hvdrun`` — the launcher CLI and programmatic ``run()``.
+
+Parity: ``horovod/run/run.py`` (argparse over every knob, hostfile
+support, YAML config, launcher orchestration) and the run-func mode
+(run.py:631-657, 702: cloudpickled fn shipped to workers, per-rank results
+collected through the KV store).
+
+Usage::
+
+    hvdrun -np 4 python train.py            # 4 local processes
+    hvdrun -np 8 -H hostA:4,hostB:4 python train.py
+    python -m horovod_tpu.runner.run -np 2 python train.py
+
+    from horovod_tpu.runner import run
+    results = run.run(train_fn, np=4)        # list of per-rank returns
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Callable, List, Optional
+
+from horovod_tpu.runner import config_parser
+from horovod_tpu.runner.hosts import allocate, parse_hostfile, parse_hosts
+from horovod_tpu.runner.http_client import KVClient
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.runner.launch import launch_workers
+from horovod_tpu.version import __version__
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job.")
+    p.add_argument("-v", "--version", action="version",
+                   version=__version__)
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   dest="np", help="total number of processes")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("-H", "--hosts", dest="hosts",
+                   help="host:slots[,host:slots...] (default: localhost)")
+    g.add_argument("--hostfile", dest="hostfile",
+                   help="path to a hostfile (mpirun 'host slots=N' style)")
+    p.add_argument("--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("--ssh-identity-file", dest="ssh_identity_file")
+    p.add_argument("--network-interface", dest="nics",
+                   help="accepted for CLI parity; address discovery is "
+                        "automatic via the rendezvous route")
+    p.add_argument("--start-timeout", type=int, default=120,
+                   dest="start_timeout")
+    p.add_argument("--disable-cache", action="store_true",
+                   dest="disable_cache")
+    p.add_argument("--output-filename", dest="output_filename")
+    p.add_argument("--config-file", dest="config_file")
+
+    tune = p.add_argument_group("tunables")
+    tune.add_argument("--fusion-threshold-mb", type=float,
+                      dest="fusion_threshold_mb")
+    tune.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
+    tune.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    tune.add_argument("--hierarchical-allreduce", action="store_true",
+                      dest="hierarchical_allreduce")
+    tune.add_argument("--hierarchical-allgather", action="store_true",
+                      dest="hierarchical_allgather")
+
+    auto = p.add_argument_group("autotune")
+    auto.add_argument("--autotune", action="store_true", dest="autotune")
+    auto.add_argument("--autotune-log-file", dest="autotune_log_file")
+
+    tl = p.add_argument_group("timeline")
+    tl.add_argument("--timeline-filename", dest="timeline_filename")
+    tl.add_argument("--timeline-mark-cycles", action="store_true",
+                    dest="timeline_mark_cycles")
+
+    st = p.add_argument_group("stall check")
+    st.add_argument("--no-stall-check", action="store_true",
+                    dest="no_stall_check")
+    st.add_argument("--stall-warning-time-seconds", type=float,
+                    dest="stall_warning_time_seconds")
+    st.add_argument("--stall-shutdown-time-seconds", type=float,
+                    dest="stall_shutdown_time_seconds")
+
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--adasum-mode", dest="adasum_mode")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command to run on every slot")
+    return p
+
+
+def _resolve_hosts(args):
+    if args.hostfile:
+        return parse_hostfile(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    return parse_hosts(f"localhost:{args.np}")
+
+
+def _collect_env(args):
+    env = {}
+    if args.config_file:
+        env.update(config_parser.env_from_config_file(args.config_file))
+    env.update(config_parser.env_from_args(args))
+    return env
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if not args.command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    hosts = _resolve_hosts(args)
+    slots = allocate(hosts, args.np)
+    env_extra = _collect_env(args)
+
+    env_extra["HVD_START_TIMEOUT"] = str(args.start_timeout)
+
+    server = RendezvousServer()
+    port = server.start()
+    # Workers reach the rendezvous at this host; for multi-host jobs they
+    # need a routable address, not loopback.
+    multi_host = any(not _is_local(s.hostname) for s in slots)
+    addr = _routable_address() if multi_host else "127.0.0.1"
+    output = None
+    if args.output_filename:
+        output = open(args.output_filename, "w")
+    try:
+        launch_workers(
+            slots, command, addr, port,
+            env_extra=env_extra,
+            ssh_port=args.ssh_port,
+            ssh_identity_file=args.ssh_identity_file,
+            output=output)
+        return 0
+    finally:
+        if output is not None:
+            output.close()
+        server.stop()
+
+
+def _is_local(hostname: str) -> bool:
+    from horovod_tpu.runner.launch import is_local
+
+    return is_local(hostname)
+
+
+def _routable_address() -> str:
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no traffic sent; picks the default NIC
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# programmatic run-func mode
+# ---------------------------------------------------------------------------
+
+
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    env: Optional[dict] = None,
+    start_timeout: int = 120,
+) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` ranks; returns the list of
+    per-rank return values in rank order (parity: horovod.run.run())."""
+    import cloudpickle
+
+    if hostfile:
+        host_list = parse_hostfile(hostfile)
+    elif hosts:
+        host_list = parse_hosts(hosts)
+    else:
+        host_list = parse_hosts(f"localhost:{np}")
+    slots = allocate(host_list, np)
+
+    server = RendezvousServer()
+    port = server.start()
+    payload = cloudpickle.dumps((fn, args, kwargs or {}))
+    multi_host = any(not _is_local(s.hostname) for s in slots)
+    addr = _routable_address() if multi_host else "127.0.0.1"
+    kv = KVClient("127.0.0.1", port)
+    kv.put("runfunc/fn", payload)
+    try:
+        env_extra = dict(env or {})
+        env_extra.setdefault("HVD_START_TIMEOUT", str(start_timeout))
+        launch_failure = None
+        try:
+            launch_workers(
+                slots,
+                [sys.executable, "-m", "horovod_tpu.runner.run_task"],
+                addr, port, env_extra=env_extra)
+        except Exception as e:
+            # Workers post (False, traceback) before exiting non-zero;
+            # surface the real exception rather than just the exit code.
+            launch_failure = e
+        results = []
+        for r in range(np):
+            blob = server.get(f"runfunc/result/{r}")
+            if blob is None:
+                if launch_failure is not None:
+                    raise launch_failure
+                raise RuntimeError(f"rank {r} returned no result")
+            ok, value = cloudpickle.loads(blob)
+            if not ok:
+                raise RuntimeError(f"rank {r} raised:\n{value}")
+            results.append(value)
+        if launch_failure is not None:
+            raise launch_failure
+        return results
+    finally:
+        server.stop()
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Worker-side stub for run-func mode: fetch the cloudpickled fn from the
+rendezvous KV store, init the runtime, run, post the result.
+
+Parity: ``horovod/run/run_task.py`` + ``task_fn.py`` (the reference ships
+the fn through its KVStoreServer the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+
+def main() -> int:
+    import cloudpickle
+
+    from horovod_tpu.runner.http_client import KVClient
+
+    addr = os.environ["HVD_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HVD_RENDEZVOUS_PORT"])
+    rank = int(os.environ.get("HVD_RANK", "0"))
+    kv = KVClient(addr, port)
+    blob = kv.get_bytes("runfunc/fn")
+    if blob is None:
+        print("run_task: no function in KV store", file=sys.stderr)
+        return 1
+    fn, args, kwargs = cloudpickle.loads(blob)
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        result = fn(*args, **kwargs)
+        payload = cloudpickle.dumps((True, result))
+        ret = 0
+    except Exception:
+        payload = cloudpickle.dumps((False, traceback.format_exc()))
+        ret = 1
+    finally:
+        hvd.shutdown()
+    kv.put(f"runfunc/result/{rank}", payload)
+    return ret
+
+
+if __name__ == "__main__":
+    sys.exit(main())

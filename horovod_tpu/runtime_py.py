@@ -298,6 +298,9 @@ class PyEngine(_EngineBase):
     def _bootstrap(self, rdv_addr: str, rdv_port: int) -> None:
         from horovod_tpu.runner.http_client import KVClient
 
+        # Launcher-provided startup budget (hvdrun --start-timeout);
+        # parity: HOROVOD_GLOO_TIMEOUT_SECONDS (gloo_context.cc:38-40).
+        start_timeout = env_util.get_float("HVD_START_TIMEOUT", 120.0)
         kv = KVClient(rdv_addr, rdv_port)
         listener = su.listen_on()
         port = listener.getsockname()[1]
@@ -309,7 +312,7 @@ class PyEngine(_EngineBase):
         for i in range(self.size):
             if i == self.rank:
                 continue
-            v = kv.wait_get(f"hvd/addr/{i}", timeout=120.0)
+            v = kv.wait_get(f"hvd/addr/{i}", timeout=start_timeout)
             host, p = v.rsplit(":", 1)
             peers[i] = (host, int(p))
 
@@ -336,15 +339,15 @@ class PyEngine(_EngineBase):
         acceptor.start()
 
         for j in range(self.rank):
-            s = su.connect_retry(*peers[j], timeout=120.0)
+            s = su.connect_retry(*peers[j], timeout=start_timeout)
             s.sendall(struct.pack("<ii", self.rank, 0))
             self._data[j] = s
         if self.rank != 0:
-            s = su.connect_retry(*peers[0], timeout=120.0)
+            s = su.connect_retry(*peers[0], timeout=start_timeout)
             s.sendall(struct.pack("<ii", self.rank, 1))
             self._ctrl_sock = s
 
-        acceptor.join(timeout=180.0)
+        acceptor.join(timeout=start_timeout * 1.5)
         if acceptor.is_alive():
             raise ConnectionError("timed out waiting for peer connections")
         for (peer_rank, chan), s in accept_results.items():

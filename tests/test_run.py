@@ -1,0 +1,156 @@
+"""Launcher unit tests (no processes spawned) — parity with the
+reference's ``test/test_run.py``: host parsing, hostfile parsing, slot
+allocation, CLI flag → env mapping."""
+
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import config_parser
+from horovod_tpu.runner.hosts import (
+    HostSlots, allocate, parse_hostfile, parse_hosts)
+from horovod_tpu.runner.run import make_parser
+
+
+def test_parse_hosts():
+    hs = parse_hosts("hostA:2,hostB:4")
+    assert hs == [HostSlots("hostA", 2), HostSlots("hostB", 4)]
+    assert parse_hosts("localhost") == [HostSlots("localhost", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("")
+    with pytest.raises(ValueError):
+        parse_hosts("host:abc")
+
+
+def test_parse_hostfile(tmp_path):
+    p = tmp_path / "hosts"
+    p.write_text(textwrap.dedent("""\
+        # comment
+        hostA slots=2
+        hostB:4
+        hostC
+    """))
+    hs = parse_hostfile(str(p))
+    assert hs == [HostSlots("hostA", 2), HostSlots("hostB", 4),
+                  HostSlots("hostC", 1)]
+
+
+def test_allocate_single_host():
+    slots = allocate([HostSlots("localhost", 4)], 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.local_size == 4 for s in slots)
+    assert all(s.cross_size == 1 and s.cross_rank == 0 for s in slots)
+
+
+def test_allocate_multi_host():
+    hosts = [HostSlots("a", 2), HostSlots("b", 2)]
+    slots = allocate(hosts, 4)
+    assert [(s.hostname, s.rank, s.local_rank) for s in slots] == [
+        ("a", 0, 0), ("a", 1, 1), ("b", 2, 0), ("b", 3, 1)]
+    assert all(s.cross_size == 2 for s in slots)
+    assert [s.cross_rank for s in slots] == [0, 0, 1, 1]
+
+
+def test_allocate_uneven():
+    hosts = [HostSlots("a", 3), HostSlots("b", 1)]
+    slots = allocate(hosts, 4)
+    assert [(s.hostname, s.local_rank) for s in slots] == [
+        ("a", 0), ("a", 1), ("a", 2), ("b", 0)]
+    # local_rank 0 exists on both hosts; 1 and 2 only on "a".
+    assert slots[0].cross_size == 2
+    assert slots[1].cross_size == 1
+    assert slots[3].cross_rank == 1
+
+
+def test_allocate_too_few_slots():
+    with pytest.raises(ValueError):
+        allocate([HostSlots("a", 2)], 3)
+
+
+def test_allocate_leaves_extra_slots_unused():
+    slots = allocate([HostSlots("a", 8)], 2)
+    assert len(slots) == 2
+    assert all(s.local_size == 2 for s in slots)
+
+
+def test_cli_env_mapping():
+    args = make_parser().parse_args([
+        "-np", "2", "--fusion-threshold-mb", "32",
+        "--cycle-time-ms", "3.5", "--autotune",
+        "--timeline-filename", "/tmp/tl.json",
+        "--no-stall-check", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env["HVD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+    assert env["HVD_CYCLE_TIME"] == "3.5"
+    assert env["HVD_AUTOTUNE"] == "1"
+    assert env["HVD_TIMELINE"] == "/tmp/tl.json"
+    assert env["HVD_STALL_CHECK_DISABLE"] == "1"
+    assert args.command == ["python", "x.py"]
+
+
+def test_cli_unset_flags_do_not_override():
+    args = make_parser().parse_args(["-np", "2", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env == {}
+
+
+def test_config_file(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("fusion-threshold-mb: 16\ncycle-time-ms: 2\n")
+    env = config_parser.env_from_config_file(str(p))
+    assert env["HVD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+    assert env["HVD_CYCLE_TIME"] == "2"
+    p2 = tmp_path / "bad.yaml"
+    p2.write_text("not-a-knob: 1\n")
+    with pytest.raises(ValueError):
+        config_parser.env_from_config_file(str(p2))
+
+
+def test_tpu_metadata_discovery(monkeypatch):
+    from horovod_tpu.runner import discovery
+
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "2")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    t = discovery.from_tpu_metadata()
+    assert t.rank == 2 * 4 + 1
+    assert t.size == 16
+    assert t.local_rank == 1 and t.local_size == 4
+    assert t.cross_rank == 2 and t.cross_size == 4
+
+
+def test_tpu_metadata_absent(monkeypatch):
+    from horovod_tpu.runner import discovery
+
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert discovery.from_tpu_metadata() is None
+
+
+def test_allocate_zero_slot_host_excluded():
+    hosts = [HostSlots("a", 0), HostSlots("b", 4)]
+    slots = allocate(hosts, 4)
+    assert len(slots) == 4
+    assert all(s.hostname == "b" for s in slots)
+
+
+def test_allocate_duplicate_hosts_merge():
+    hosts = [HostSlots("a", 2), HostSlots("a", 2)]
+    slots = allocate(hosts, 4)
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.local_size == 4 for s in slots)
+    assert all(s.cross_size == 1 for s in slots)
+
+
+def test_basics_uses_tpu_metadata(monkeypatch):
+    import horovod_tpu.basics as basics
+
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "0")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "1")
+    r = basics._discover(None, None, None, None, None, None)
+    assert r == (1, 2, 1, 2, 0, 1)
