@@ -1,0 +1,206 @@
+// extern "C" surface of the native engine, consumed by
+// horovod_tpu/native.py over ctypes.
+//
+// Role parity: the ctypes-visible C API in horovod/common/operations.cc:650-788
+// (horovod_init/rank/size/...) plus the enqueue/handle surface of the torch
+// v2 binding (horovod/torch/mpi_ops_v2.cc:53-299) — collapsed into one API
+// since every framework front-end here goes through numpy buffers.
+//
+// Convention: enqueue functions return a handle >= 0 or -1 with the message
+// available via hvd_last_error() (thread-local).  hvd_wait() returns the
+// StatusType; result buffers for size-negotiated ops (allgather/alltoall)
+// are owned by the engine until hvd_release().
+
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+
+namespace {
+
+std::unique_ptr<hvd::Engine> g_engine;
+thread_local std::string g_last_error;
+
+hvd::TensorShape MakeShape(int ndim, const int64_t* dims) {
+  hvd::TensorShape s;
+  s.dims.assign(dims, dims + ndim);
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_create(int rank, int size, int local_rank, int local_size,
+               int cross_rank, int cross_size, const int32_t* data_fds,
+               const int32_t* ctrl_fds, double cycle_time_s,
+               int64_t fusion_threshold, double stall_warn_s,
+               double stall_shutdown_s, int stall_check_disable) {
+  if (g_engine) {
+    g_last_error = "engine already initialized";
+    return -1;
+  }
+  hvd::EngineConfig cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.local_rank = local_rank;
+  cfg.local_size = local_size;
+  cfg.cross_rank = cross_rank;
+  cfg.cross_size = cross_size;
+  cfg.cycle_time_s = cycle_time_s;
+  cfg.fusion_threshold = fusion_threshold;
+  cfg.stall_warn_s = stall_warn_s;
+  cfg.stall_shutdown_s = stall_shutdown_s;
+  cfg.stall_check_disable = stall_check_disable != 0;
+  std::vector<int> data(data_fds, data_fds + size);
+  std::vector<int> ctrl(ctrl_fds, ctrl_fds + size);
+  try {
+    g_engine = std::make_unique<hvd::Engine>(cfg, std::move(data),
+                                             std::move(ctrl));
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+  return 0;
+}
+
+void hvd_shutdown() {
+  if (g_engine) {
+    g_engine->Shutdown();
+    g_engine.reset();
+  }
+}
+
+int hvd_is_aborted() { return g_engine && g_engine->aborted() ? 1 : 0; }
+
+const char* hvd_last_error() { return g_last_error.c_str(); }
+
+int64_t hvd_allreduce_async(const char* name, void* buf, int ndim,
+                            const int64_t* dims, int dtype, int op,
+                            double prescale, double postscale) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::string err;
+  int64_t h = g_engine->EnqueueAllreduce(
+      name, buf, MakeShape(ndim, dims), static_cast<hvd::DataType>(dtype),
+      static_cast<hvd::ReduceOp>(op), prescale, postscale, &err);
+  if (h < 0) g_last_error = err;
+  return h;
+}
+
+int64_t hvd_allgather_async(const char* name, const void* buf, int ndim,
+                            const int64_t* dims, int dtype) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::string err;
+  int64_t h = g_engine->EnqueueAllgather(name, buf, MakeShape(ndim, dims),
+                                         static_cast<hvd::DataType>(dtype),
+                                         &err);
+  if (h < 0) g_last_error = err;
+  return h;
+}
+
+int64_t hvd_broadcast_async(const char* name, void* buf, int ndim,
+                            const int64_t* dims, int dtype, int root_rank) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::string err;
+  int64_t h = g_engine->EnqueueBroadcast(name, buf, MakeShape(ndim, dims),
+                                         static_cast<hvd::DataType>(dtype),
+                                         root_rank, &err);
+  if (h < 0) g_last_error = err;
+  return h;
+}
+
+int64_t hvd_alltoall_async(const char* name, const void* buf, int ndim,
+                           const int64_t* dims, int dtype,
+                           const int64_t* splits, int nsplits) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::vector<int64_t> sp;
+  if (splits && nsplits > 0) sp.assign(splits, splits + nsplits);
+  std::string err;
+  int64_t h = g_engine->EnqueueAlltoall(name, buf, MakeShape(ndim, dims),
+                                        static_cast<hvd::DataType>(dtype),
+                                        sp, &err);
+  if (h < 0) g_last_error = err;
+  return h;
+}
+
+// 1 = done, 0 = pending, -1 = unknown handle.
+int hvd_poll(int64_t handle) {
+  if (!g_engine) return -1;
+  return g_engine->handles().Poll(handle);
+}
+
+// Blocks; returns the StatusType value.
+int hvd_wait(int64_t handle) {
+  if (!g_engine) return static_cast<int>(hvd::StatusType::ABORTED);
+  return static_cast<int>(g_engine->handles().Wait(handle));
+}
+
+// Error message of a completed handle (empty string if none).
+const char* hvd_handle_error(int64_t handle) {
+  if (!g_engine) return "engine not initialized";
+  auto* st = g_engine->handles().Get(handle);
+  if (!st) return "unknown handle";
+  g_last_error = st->status.reason;
+  return g_last_error.c_str();
+}
+
+int64_t hvd_result_nbytes(int64_t handle) {
+  if (!g_engine) return -1;
+  auto* st = g_engine->handles().Get(handle);
+  return st ? static_cast<int64_t>(st->result.size()) : -1;
+}
+
+const void* hvd_result_data(int64_t handle) {
+  if (!g_engine) return nullptr;
+  auto* st = g_engine->handles().Get(handle);
+  return st && !st->result.empty() ? st->result.data() : nullptr;
+}
+
+// Copies up to cap recv splits into out; returns the count.
+int hvd_result_splits(int64_t handle, int64_t* out, int cap) {
+  if (!g_engine) return -1;
+  auto* st = g_engine->handles().Get(handle);
+  if (!st) return -1;
+  int n = static_cast<int>(st->recv_splits.size());
+  for (int i = 0; i < n && i < cap; ++i) out[i] = st->recv_splits[i];
+  return n;
+}
+
+void hvd_release(int64_t handle) {
+  if (g_engine) g_engine->handles().Release(handle);
+}
+
+int hvd_barrier() {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::string err;
+  int rc = g_engine->Barrier(&err);
+  if (rc != 0) g_last_error = err;
+  return rc;
+}
+
+int hvd_join() {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  return g_engine->Join();
+}
+
+}  // extern "C"

@@ -1,0 +1,989 @@
+#include "engine.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+#include "kernels.h"
+#include "sockets.h"
+
+namespace hvd {
+namespace {
+
+double NowS() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t Mod(int64_t a, int64_t m) { return ((a % m) + m) % m; }
+
+// NCCL-style near-equal chunking (parity: cpu_backend._chunk_bounds).
+std::vector<int64_t> ChunkBounds(int64_t n, int parts) {
+  int64_t base = n / parts, rem = n % parts;
+  std::vector<int64_t> bounds{0};
+  for (int i = 0; i < parts; ++i)
+    bounds.push_back(bounds.back() + base + (i < rem ? 1 : 0));
+  return bounds;
+}
+
+const char* OpName(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "ALLREDUCE";
+    case RequestType::ALLGATHER: return "ALLGATHER";
+    case RequestType::BROADCAST: return "BROADCAST";
+    case RequestType::JOIN: return "JOIN";
+    case RequestType::ALLTOALL: return "ALLTOALL";
+    case RequestType::BARRIER: return "BARRIER";
+    case RequestType::REDUCESCATTER: return "REDUCESCATTER";
+  }
+  return "?";
+}
+
+const char* DtypeName(DataType t) {
+  switch (t) {
+    case DataType::UINT8: return "UINT8";
+    case DataType::INT8: return "INT8";
+    case DataType::UINT16: return "UINT16";
+    case DataType::INT16: return "INT16";
+    case DataType::INT32: return "INT32";
+    case DataType::INT64: return "INT64";
+    case DataType::FLOAT16: return "FLOAT16";
+    case DataType::FLOAT32: return "FLOAT32";
+    case DataType::FLOAT64: return "FLOAT64";
+    case DataType::BOOL: return "BOOL";
+    case DataType::BFLOAT16: return "BFLOAT16";
+  }
+  return "?";
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// HandleManager
+// ---------------------------------------------------------------------------
+
+int64_t HandleManager::Allocate() {
+  std::lock_guard<std::mutex> lk(mu_);
+  int64_t h = next_++;
+  states_[h];  // default-construct pending state
+  return h;
+}
+
+void HandleManager::MarkDone(int64_t h, Status status,
+                             std::vector<uint8_t> result,
+                             std::vector<int64_t> splits) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = states_.find(h);
+    if (it == states_.end()) return;
+    it->second.done = true;
+    it->second.status = std::move(status);
+    it->second.result = std::move(result);
+    it->second.recv_splits = std::move(splits);
+  }
+  cv_.notify_all();
+}
+
+int HandleManager::Poll(int64_t h) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = states_.find(h);
+  if (it == states_.end()) return -1;
+  return it->second.done ? 1 : 0;
+}
+
+StatusType HandleManager::Wait(int64_t h) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto it = states_.find(h);
+  if (it == states_.end()) return StatusType::INVALID_ARGUMENT;
+  cv_.wait(lk, [&] { return states_[h].done; });
+  return states_[h].status.type;
+}
+
+HandleState* HandleManager::Get(int64_t h) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = states_.find(h);
+  return it == states_.end() ? nullptr : &it->second;
+}
+
+void HandleManager::Release(int64_t h) {
+  std::lock_guard<std::mutex> lk(mu_);
+  states_.erase(h);
+}
+
+// ---------------------------------------------------------------------------
+// Engine lifecycle
+// ---------------------------------------------------------------------------
+
+Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
+               std::vector<int> ctrl_fds)
+    : cfg_(cfg), data_fds_(std::move(data_fds)), ctrl_fds_(std::move(ctrl_fds)) {
+  for (int fd : data_fds_)
+    if (fd >= 0) SetNoDelay(fd);
+  for (int fd : ctrl_fds_)
+    if (fd >= 0) SetNoDelay(fd);
+  last_stall_check_s_ = NowS();
+  bg_ = std::thread([this] { BackgroundLoop(); });
+}
+
+Engine::~Engine() { Shutdown(); }
+
+void Engine::Shutdown() {
+  bool expected = false;
+  if (!shutdown_.compare_exchange_strong(expected, true)) {
+    if (bg_.joinable()) bg_.join();
+    return;
+  }
+  if (bg_.joinable()) bg_.join();
+  for (int fd : data_fds_)
+    if (fd >= 0) ::close(fd);
+  for (int fd : ctrl_fds_)
+    if (fd >= 0) ::close(fd);
+}
+
+// ---------------------------------------------------------------------------
+// Enqueue side (caller threads)
+// ---------------------------------------------------------------------------
+
+bool Engine::ClaimName(const std::string& name, std::string* err) {
+  if (pending_names_.count(name)) {
+    *err = "Requested a collective on a tensor with the same name as "
+           "another tensor that is currently being processed: " +
+           name;
+    return false;
+  }
+  pending_names_.insert(name);
+  return true;
+}
+
+void Engine::ReleaseName(const std::string& name) {
+  std::lock_guard<std::mutex> lk(queue_mu_);
+  pending_names_.erase(name);
+}
+
+int64_t Engine::Enqueue(TensorTableEntry entry, std::string* err) {
+  std::lock_guard<std::mutex> lk(queue_mu_);
+  if (aborted_.load() || shutdown_.load()) {
+    *err = "horovod_tpu runtime has been shut down";
+    return -1;
+  }
+  if (!ClaimName(entry.name, err)) return -1;
+  entry.enqueue_s = NowS();
+  int64_t h = entry.handle;
+  request_queue_.push_back(entry.request);
+  table_.emplace(entry.name, std::move(entry));
+  return h;
+}
+
+int64_t Engine::EnqueueAllreduce(const std::string& name, void* buf,
+                                 const TensorShape& shape, DataType dt,
+                                 ReduceOp op, double prescale,
+                                 double postscale, std::string* err) {
+  TensorTableEntry e;
+  e.name = name;
+  e.data = static_cast<uint8_t*>(buf);
+  e.nelems = shape.num_elements();
+  e.handle = handles_.Allocate();
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::ALLREDUCE;
+  e.request.tensor_type = dt;
+  e.request.tensor_name = name;
+  e.request.tensor_shape = shape;
+  e.request.reduce_op = op;
+  e.request.prescale_factor = prescale;
+  e.request.postscale_factor = postscale;
+  return Enqueue(std::move(e), err);
+}
+
+int64_t Engine::EnqueueAllgather(const std::string& name, const void* buf,
+                                 const TensorShape& shape, DataType dt,
+                                 std::string* err) {
+  TensorTableEntry e;
+  e.name = name;
+  e.data = static_cast<uint8_t*>(const_cast<void*>(buf));
+  e.nelems = shape.num_elements();
+  e.handle = handles_.Allocate();
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::ALLGATHER;
+  e.request.tensor_type = dt;
+  e.request.tensor_name = name;
+  e.request.tensor_shape = shape;
+  return Enqueue(std::move(e), err);
+}
+
+int64_t Engine::EnqueueBroadcast(const std::string& name, void* buf,
+                                 const TensorShape& shape, DataType dt,
+                                 int root_rank, std::string* err) {
+  if (root_rank < 0 || root_rank >= cfg_.size) {
+    *err = "broadcast root rank " + std::to_string(root_rank) +
+           " out of range [0, " + std::to_string(cfg_.size) + ")";
+    return -1;
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.data = static_cast<uint8_t*>(buf);
+  e.nelems = shape.num_elements();
+  e.handle = handles_.Allocate();
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::BROADCAST;
+  e.request.tensor_type = dt;
+  e.request.tensor_name = name;
+  e.request.tensor_shape = shape;
+  e.request.root_rank = root_rank;
+  return Enqueue(std::move(e), err);
+}
+
+int64_t Engine::EnqueueAlltoall(const std::string& name, const void* buf,
+                                const TensorShape& shape, DataType dt,
+                                const std::vector<int64_t>& splits,
+                                std::string* err) {
+  if (!splits.empty()) {
+    int64_t total = 0;
+    for (auto s : splits) total += s;
+    if (shape.dims.empty() || total != shape.dims[0]) {
+      *err = "splits must sum to dim 0";
+      return -1;
+    }
+  } else if (!shape.dims.empty() && shape.dims[0] % cfg_.size != 0) {
+    *err = "alltoall without splits requires dim 0 divisible by the world "
+           "size";
+    return -1;
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.data = static_cast<uint8_t*>(const_cast<void*>(buf));
+  e.nelems = shape.num_elements();
+  e.handle = handles_.Allocate();
+  e.splits = splits;
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::ALLTOALL;
+  e.request.tensor_type = dt;
+  e.request.tensor_name = name;
+  e.request.tensor_shape = shape;
+  return Enqueue(std::move(e), err);
+}
+
+int Engine::Barrier(std::string* err) {
+  TensorTableEntry e;
+  e.name = "__barrier." + std::to_string(barrier_counter_.fetch_add(1));
+  static int32_t zero = 0;
+  e.data = reinterpret_cast<uint8_t*>(&zero);
+  e.nelems = 1;
+  e.handle = handles_.Allocate();
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::BARRIER;
+  e.request.tensor_name = e.name;
+  e.request.tensor_type = DataType::INT32;
+  int64_t h = Enqueue(std::move(e), err);
+  if (h < 0) return -1;
+  StatusType st = handles_.Wait(h);
+  handles_.Release(h);
+  return st == StatusType::OK ? 0 : -1;
+}
+
+int Engine::Join() {
+  int64_t h = handles_.Allocate();
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    joined_ = true;
+    join_handle_ = h;
+    Request req;
+    req.request_rank = cfg_.rank;
+    req.request_type = RequestType::JOIN;
+    req.tensor_name = "__join__";
+    request_queue_.push_back(req);
+  }
+  handles_.Wait(h);
+  handles_.Release(h);
+  return last_joined_rank_.load();
+}
+
+// ---------------------------------------------------------------------------
+// Background loop
+// ---------------------------------------------------------------------------
+
+void Engine::BackgroundLoop() {
+  try {
+    while (!shutdown_.load()) {
+      double t0 = NowS();
+      if (!RunLoopOnce()) break;
+      double dt = NowS() - t0;
+      if (dt < cfg_.cycle_time_s) {
+        auto us = static_cast<int64_t>((cfg_.cycle_time_s - dt) * 1e6);
+        std::this_thread::sleep_for(std::chrono::microseconds(us));
+      }
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "[hvd-core %d] background loop failed: %s\n",
+                 cfg_.rank, e.what());
+    Abort(e.what());
+  }
+  DrainOnShutdown();
+}
+
+void Engine::DrainOnShutdown() {
+  std::vector<TensorTableEntry> entries;
+  int64_t jh;
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    for (auto& kv : table_) entries.push_back(std::move(kv.second));
+    table_.clear();
+    request_queue_.clear();
+    jh = join_handle_;
+    join_handle_ = -1;
+  }
+  for (auto& e : entries) {
+    ReleaseName(e.name);
+    if (e.handle >= 0)
+      handles_.MarkDone(e.handle,
+                        Status::Aborted("Horovod has been shut down."));
+  }
+  if (jh >= 0) handles_.MarkDone(jh, Status::OK());
+}
+
+bool Engine::RunLoopOnce() {
+  std::vector<Request> msgs;
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    msgs.swap(request_queue_);
+  }
+  if (cfg_.rank == 0) return CoordinatorCycle(std::move(msgs));
+  return WorkerCycle(std::move(msgs));
+}
+
+bool Engine::WorkerCycle(std::vector<Request> msgs) {
+  int ctrl = ctrl_fds_[0];
+  if (!msgs.empty()) {
+    auto payload = EncodeRequestList(msgs, /*shutdown=*/false);
+    SendFrame(ctrl, kTagRequestList, payload.data(), payload.size());
+  }
+  while (Readable(ctrl, 0)) {
+    std::vector<uint8_t> payload;
+    uint8_t tag = RecvFrame(ctrl, &payload);
+    if (tag != kTagResponseList)
+      throw SocketError("worker expected response list, got tag " +
+                        std::to_string(tag));
+    std::vector<Response> responses;
+    bool shutdown = false;
+    if (!DecodeResponseList(payload.data(), payload.size(), &responses,
+                            &shutdown))
+      throw SocketError("malformed response list");
+    for (auto& resp : responses) PerformResponse(resp);
+    if (shutdown) {
+      shutdown_.store(true);
+      return false;
+    }
+  }
+  return true;
+}
+
+void Engine::AbsorbRequest(const Request& req,
+                           std::vector<std::string>* ready) {
+  if (req.request_type == RequestType::JOIN) {
+    joined_ranks_.insert(req.request_rank);
+    last_joined_rank_.store(req.request_rank);
+    // Tensors waiting only on joined ranks become ready.
+    for (auto& kv : msg_table_) {
+      if (static_cast<int>(kv.second.requests.size()) ==
+          cfg_.size - static_cast<int>(joined_ranks_.size())) {
+        if (std::find(ready->begin(), ready->end(), kv.first) == ready->end())
+          ready->push_back(kv.first);
+      }
+    }
+    return;
+  }
+  auto& ent = msg_table_[req.tensor_name];
+  if (ent.requests.empty()) ent.first_seen_s = NowS();
+  ent.requests.push_back(req);
+  if (static_cast<int>(ent.requests.size()) ==
+      cfg_.size - static_cast<int>(joined_ranks_.size()))
+    ready->push_back(req.tensor_name);
+}
+
+bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
+  std::vector<std::string> ready;
+  bool shutdown = false;
+
+  for (auto& req : msgs) AbsorbRequest(req, &ready);
+  for (int r = 1; r < cfg_.size; ++r) {
+    int fd = ctrl_fds_[r];
+    while (Readable(fd, 0)) {
+      std::vector<uint8_t> payload;
+      uint8_t tag = RecvFrame(fd, &payload);
+      if (tag != kTagRequestList)
+        throw SocketError("coordinator expected request list, got tag " +
+                          std::to_string(tag));
+      std::vector<Request> reqs;
+      bool peer_shutdown = false;
+      if (!DecodeRequestList(payload.data(), payload.size(), &reqs,
+                             &peer_shutdown))
+        throw SocketError("malformed request list");
+      shutdown = shutdown || peer_shutdown;
+      for (auto& req : reqs) AbsorbRequest(req, &ready);
+    }
+  }
+
+  std::vector<Response> responses;
+  for (auto& name : ready) {
+    auto it = msg_table_.find(name);
+    if (it == msg_table_.end()) continue;
+    auto reqs = std::move(it->second.requests);
+    msg_table_.erase(it);
+    responses.push_back(ConstructResponse(name, reqs));
+  }
+
+  if (static_cast<int>(joined_ranks_.size()) == cfg_.size) {
+    Response join_resp;
+    join_resp.response_type = ResponseType::JOIN;
+    join_resp.tensor_sizes = {last_joined_rank_.load()};
+    responses.push_back(join_resp);
+    joined_ranks_.clear();
+  }
+
+  if (!cfg_.stall_check_disable) shutdown = CheckStalls() || shutdown;
+
+  if (!responses.empty() || shutdown) {
+    auto fused = FuseResponses(std::move(responses));
+    auto payload = EncodeResponseList(fused, shutdown);
+    for (int r = 1; r < cfg_.size; ++r)
+      SendFrame(ctrl_fds_[r], kTagResponseList, payload.data(),
+                payload.size());
+    for (auto& resp : fused) PerformResponse(resp);
+    if (shutdown) {
+      shutdown_.store(true);
+      return false;
+    }
+  }
+  return true;
+}
+
+bool Engine::CheckStalls() {
+  double now = NowS();
+  if (now - last_stall_check_s_ < cfg_.stall_warn_s / 4) return false;
+  last_stall_check_s_ = now;
+  bool shutdown = false;
+  for (auto& kv : msg_table_) {
+    double waited = now - kv.second.first_seen_s;
+    if (waited > cfg_.stall_warn_s) {
+      std::string have;
+      for (auto& r : kv.second.requests)
+        have += std::to_string(r.request_rank) + " ";
+      std::fprintf(stderr,
+                   "[hvd-core 0] Stalled tensor %s: ready on ranks [ %s] "
+                   "for %.0fs\n",
+                   kv.first.c_str(), have.c_str(), waited);
+      if (cfg_.stall_shutdown_s > 0 && waited > cfg_.stall_shutdown_s) {
+        std::fprintf(stderr,
+                     "[hvd-core 0] Stalled tensor %s exceeded shutdown "
+                     "threshold; shutting down\n",
+                     kv.first.c_str());
+        shutdown = true;
+      }
+    }
+  }
+  return shutdown;
+}
+
+// ---------------------------------------------------------------------------
+// Response construction + fusion (coordinator)
+// ---------------------------------------------------------------------------
+
+Response Engine::ConstructResponse(const std::string& name,
+                                   const std::vector<Request>& reqs) {
+  const Request& first = reqs[0];
+  std::string err;
+  auto mismatch = [&](auto pred) {
+    for (auto& r : reqs)
+      if (pred(r)) return true;
+    return false;
+  };
+
+  if (mismatch([&](const Request& r) {
+        return r.request_type != first.request_type;
+      })) {
+    err = "Mismatched collective operations for tensor " + name;
+  } else if (mismatch([&](const Request& r) {
+               return r.tensor_type != first.tensor_type;
+             })) {
+    err = "Mismatched data types for tensor " + name + ": ";
+    std::set<std::string> types;
+    for (auto& r : reqs) types.insert(DtypeName(r.tensor_type));
+    bool firstt = true;
+    for (auto& t : types) {
+      if (!firstt) err += ", ";
+      err += t;
+      firstt = false;
+    }
+  } else if (first.request_type == RequestType::ALLREDUCE) {
+    if (mismatch([&](const Request& r) {
+          return r.tensor_shape != first.tensor_shape;
+        })) {
+      err = "Mismatched allreduce tensor shapes for " + name;
+    } else if (mismatch([&](const Request& r) {
+                 return r.reduce_op != first.reduce_op;
+               })) {
+      err = "Mismatched reduce ops for tensor " + name;
+    }
+  } else if (first.request_type == RequestType::BROADCAST) {
+    if (mismatch([&](const Request& r) {
+          return r.root_rank != first.root_rank;
+        })) {
+      err = "Mismatched broadcast root ranks for " + name;
+    } else if (mismatch([&](const Request& r) {
+                 return r.tensor_shape != first.tensor_shape;
+               })) {
+      err = "Mismatched broadcast tensor shapes for " + name;
+    }
+  } else if (first.request_type == RequestType::ALLGATHER) {
+    for (auto& r : reqs) {
+      if (r.tensor_shape.dims.size() != first.tensor_shape.dims.size() ||
+          !std::equal(r.tensor_shape.dims.begin() + 1,
+                      r.tensor_shape.dims.end(),
+                      first.tensor_shape.dims.begin() + 1)) {
+        err = "Mismatched allgather tensor shapes for " + name +
+              ": all dimensions except the first must match";
+        break;
+      }
+    }
+  }
+
+  if (!err.empty()) {
+    Response r;
+    r.response_type = ResponseType::ERROR;
+    r.tensor_names = {name};
+    r.error_message = err;
+    return r;
+  }
+
+  Response resp;
+  resp.response_type = static_cast<ResponseType>(first.request_type);
+  resp.tensor_names = {name};
+  resp.tensor_type = first.tensor_type;
+  resp.devices = {first.device};
+  if (first.request_type == RequestType::ALLREDUCE) {
+    resp.tensor_sizes = {first.tensor_shape.num_elements()};
+    resp.reduce_op = first.reduce_op;
+    resp.prescale_factor = first.prescale_factor;
+    resp.postscale_factor = first.postscale_factor;
+  } else if (first.request_type == RequestType::ALLGATHER) {
+    // First-dim size per rank, rank order (0 for joined ranks).
+    std::map<int, const Request*> by_rank;
+    for (auto& r : reqs) by_rank[r.request_rank] = &r;
+    for (int r = 0; r < cfg_.size; ++r) {
+      auto it = by_rank.find(r);
+      resp.tensor_sizes.push_back(
+          it != by_rank.end() ? it->second->tensor_shape.dims[0] : 0);
+    }
+  } else if (first.request_type == RequestType::BROADCAST) {
+    resp.tensor_sizes = {first.root_rank};
+  }
+  return resp;
+}
+
+std::vector<Response> Engine::FuseResponses(std::vector<Response> responses) {
+  std::vector<Response> out;
+  Response pending;
+  bool have_pending = false;
+  int64_t pending_bytes = 0;
+  for (auto& r : responses) {
+    bool fusable = r.response_type == ResponseType::ALLREDUCE &&
+                   r.error_message.empty();
+    if (!fusable) {
+      if (have_pending) {
+        out.push_back(std::move(pending));
+        have_pending = false;
+      }
+      out.push_back(std::move(r));
+      continue;
+    }
+    int64_t nbytes = 0;
+    for (auto s : r.tensor_sizes) nbytes += s;
+    nbytes *= static_cast<int64_t>(ItemSize(r.tensor_type));
+    if (have_pending && pending.tensor_type == r.tensor_type &&
+        pending.devices == r.devices && pending.reduce_op == r.reduce_op &&
+        pending.prescale_factor == r.prescale_factor &&
+        pending.postscale_factor == r.postscale_factor &&
+        pending_bytes + nbytes <= cfg_.fusion_threshold) {
+      pending.tensor_names.insert(pending.tensor_names.end(),
+                                  r.tensor_names.begin(),
+                                  r.tensor_names.end());
+      pending.tensor_sizes.insert(pending.tensor_sizes.end(),
+                                  r.tensor_sizes.begin(),
+                                  r.tensor_sizes.end());
+      pending_bytes += nbytes;
+    } else {
+      if (have_pending) out.push_back(std::move(pending));
+      pending = std::move(r);
+      have_pending = true;
+      pending_bytes = nbytes;
+    }
+  }
+  if (have_pending) out.push_back(std::move(pending));
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Execution
+// ---------------------------------------------------------------------------
+
+std::vector<TensorTableEntry> Engine::GetEntries(const Response& resp) {
+  // Parity: GetTensorEntriesFromResponse (tensor_queue.cc:72-117) — a
+  // joined rank gets zero stand-ins.
+  std::vector<TensorTableEntry> entries;
+  std::lock_guard<std::mutex> lk(queue_mu_);
+  for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+    const auto& nm = resp.tensor_names[i];
+    auto it = table_.find(nm);
+    if (it != table_.end()) {
+      entries.push_back(std::move(it->second));
+      table_.erase(it);
+    } else {
+      TensorTableEntry e;
+      e.name = nm;
+      e.handle = -1;
+      e.request.request_rank = cfg_.rank;
+      e.request.tensor_type = resp.tensor_type;
+      if (resp.response_type == ResponseType::ALLREDUCE) {
+        int64_t n = resp.tensor_sizes[i];
+        e.standin.assign(n * ItemSize(resp.tensor_type), 0);
+        e.data = e.standin.data();
+        e.nelems = n;
+        e.request.tensor_shape.dims = {n};
+      } else {
+        e.nelems = 0;
+        e.request.tensor_shape.dims = {0};
+      }
+      entries.push_back(std::move(e));
+    }
+  }
+  return entries;
+}
+
+void Engine::PerformResponse(const Response& resp) {
+  if (resp.response_type == ResponseType::JOIN) {
+    if (!resp.tensor_sizes.empty())
+      last_joined_rank_.store(static_cast<int>(resp.tensor_sizes[0]));
+    int64_t jh;
+    {
+      std::lock_guard<std::mutex> lk(queue_mu_);
+      jh = join_handle_;
+      join_handle_ = -1;
+      joined_ = false;
+    }
+    if (jh >= 0) handles_.MarkDone(jh, Status::OK());
+    return;
+  }
+
+  if (resp.response_type == ResponseType::ERROR) {
+    for (const auto& nm : resp.tensor_names) {
+      TensorTableEntry e;
+      bool found = false;
+      {
+        std::lock_guard<std::mutex> lk(queue_mu_);
+        auto it = table_.find(nm);
+        if (it != table_.end()) {
+          e = std::move(it->second);
+          table_.erase(it);
+          found = true;
+        }
+      }
+      if (found) {
+        ReleaseName(e.name);
+        if (e.handle >= 0)
+          handles_.MarkDone(e.handle,
+                            Status::PreconditionError(resp.error_message));
+      }
+    }
+    return;
+  }
+
+  auto entries = GetEntries(resp);
+  Status status = Status::OK();
+  try {
+    switch (resp.response_type) {
+      case ResponseType::ALLREDUCE:
+        DoAllreduce(entries, resp);
+        break;
+      case ResponseType::ALLGATHER:
+        DoAllgather(entries, resp);
+        break;
+      case ResponseType::BROADCAST:
+        DoBroadcast(entries, resp);
+        break;
+      case ResponseType::ALLTOALL:
+        DoAlltoall(entries, resp);
+        break;
+      case ResponseType::BARRIER:
+        DoBarrier();
+        for (auto& e : entries) {
+          ReleaseName(e.name);
+          if (e.handle >= 0) handles_.MarkDone(e.handle, Status::OK());
+        }
+        return;
+      default:
+        throw std::runtime_error("bad response type");
+    }
+  } catch (const std::exception& ex) {
+    std::fprintf(stderr, "[hvd-core %d] collective %s failed: %s\n",
+                 cfg_.rank, OpName(static_cast<RequestType>(resp.response_type)),
+                 ex.what());
+    status = Status::UnknownError(ex.what());
+    for (auto& e : entries) {
+      ReleaseName(e.name);
+      if (e.handle >= 0) handles_.MarkDone(e.handle, status);
+    }
+    // Data-plane failure leaves sockets in an undefined protocol state.
+    Abort(ex.what());
+  }
+}
+
+void Engine::DoAllreduce(std::vector<TensorTableEntry>& entries,
+                         const Response& resp) {
+  DataType dt = resp.tensor_type;
+  size_t isz = ItemSize(dt);
+  // Op and scales come from the negotiated response — identical on every
+  // rank, including joined ranks whose entries are zero stand-ins.
+  ReduceOp op = resp.reduce_op;
+  double prescale = resp.prescale_factor;
+  double postscale = resp.postscale_factor;
+
+  int64_t total = 0;
+  for (auto& e : entries) total += e.nelems;
+
+  uint8_t* flat;
+  bool fused = entries.size() > 1;
+  if (fused) {
+    // Parity: MemcpyInFusionBuffer — one lazily grown persistent buffer.
+    if (fusion_buffer_.size() < static_cast<size_t>(total) * isz)
+      fusion_buffer_.resize(total * isz);
+    flat = fusion_buffer_.data();
+    int64_t off = 0;
+    for (auto& e : entries) {
+      std::memcpy(flat + off * isz, e.data, e.nelems * isz);
+      off += e.nelems;
+    }
+  } else {
+    flat = entries[0].data;  // in-place, zero copy
+  }
+
+  if (prescale != 1.0) ScaleInPlace(flat, total, dt, prescale);
+
+  if (op == ReduceOp::ADASUM) {
+    AdasumFlat(flat, total, dt);
+  } else {
+    RingAllreduceFlat(flat, total, dt, op);
+  }
+
+  if (op == ReduceOp::AVERAGE) AverageInPlace(flat, total, dt, cfg_.size);
+  if (postscale != 1.0) ScaleInPlace(flat, total, dt, postscale);
+
+  if (fused) {
+    int64_t off = 0;
+    for (auto& e : entries) {
+      std::memcpy(e.data, flat + off * isz, e.nelems * isz);
+      off += e.nelems;
+    }
+  }
+  for (auto& e : entries) {
+    ReleaseName(e.name);
+    if (e.handle >= 0) handles_.MarkDone(e.handle, Status::OK());
+  }
+}
+
+void Engine::RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
+                               ReduceOp op) {
+  // Parity: cpu_backend.ring_allreduce_flat — ring reduce-scatter +
+  // ring allgather, chunk boundaries and combine order identical so the
+  // two engines are bit-identical (they can share one job).
+  int size = cfg_.size, rank = cfg_.rank;
+  if (size == 1) return;
+  size_t isz = ItemSize(dt);
+  int right = data_fds_[Mod(rank + 1, size)];
+  int left = data_fds_[Mod(rank - 1, size)];
+  auto bounds = ChunkBounds(nelems, size);
+  std::vector<uint8_t> tmp;
+
+  // Phase 1: ring reduce-scatter.
+  for (int step = 0; step < size - 1; ++step) {
+    int64_t send_idx = Mod(rank - step, size);
+    int64_t recv_idx = Mod(rank - step - 1, size);
+    int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
+    int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
+    tmp.resize(recv_n * isz);
+    ExchangeInto(right, buf + bounds[send_idx] * isz, send_n * isz, left,
+                 tmp.data(), recv_n * isz);
+    CombineInto(buf + bounds[recv_idx] * isz, tmp.data(), recv_n, dt, op);
+  }
+
+  // Phase 2: ring allgather of the reduced chunks.
+  for (int step = 0; step < size - 1; ++step) {
+    int64_t send_idx = Mod(rank + 1 - step, size);
+    int64_t recv_idx = Mod(rank - step, size);
+    int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
+    int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
+    ExchangeInto(right, buf + bounds[send_idx] * isz, send_n * isz, left,
+                 buf + bounds[recv_idx] * isz, recv_n * isz);
+  }
+}
+
+void Engine::AdasumFlat(uint8_t* buf, int64_t nelems, DataType dt) {
+  // Parity: cpu_backend._adasum_flat — recursive distance-doubling partner
+  // exchange, fp64 accumulation, power-of-two world sizes.
+  int size = cfg_.size, rank = cfg_.rank;
+  if (size == 1) return;
+  if (size & (size - 1))
+    throw std::runtime_error("Adasum requires a power-of-two world size");
+  std::vector<double> acc(nelems), other(nelems);
+  ToF64(buf, acc.data(), nelems, dt);
+  for (int k = 1; k < size; k *= 2) {
+    int partner = rank ^ k;
+    int fd = data_fds_[partner];
+    ExchangeInto(fd, acc.data(), nelems * 8, fd, other.data(), nelems * 8);
+    if (rank < partner) {
+      AdasumPairF64(acc.data(), other.data(), acc.data(), nelems);
+    } else {
+      AdasumPairF64(other.data(), acc.data(), acc.data(), nelems);
+    }
+  }
+  FromF64(acc.data(), buf, nelems, dt);
+}
+
+void Engine::DoAllgather(std::vector<TensorTableEntry>& entries,
+                         const Response& resp) {
+  // Ragged ring allgatherv (parity: cpu_backend.allgather; displacement
+  // logic parity: MPIAllgather, mpi_operations.cc:83-166).
+  int size = cfg_.size, rank = cfg_.rank;
+  for (auto& e : entries) {
+    size_t isz = ItemSize(resp.tensor_type);
+    struct Block {
+      const uint8_t* ptr = nullptr;
+      size_t len = 0;
+      std::vector<uint8_t> own;
+    };
+    std::vector<Block> blocks(size);
+    blocks[rank].ptr = e.data;
+    blocks[rank].len = e.nelems * isz;
+    if (size > 1) {
+      int right = data_fds_[Mod(rank + 1, size)];
+      int left = data_fds_[Mod(rank - 1, size)];
+      for (int step = 0; step < size - 1; ++step) {
+        int64_t send_idx = Mod(rank - step, size);
+        int64_t recv_idx = Mod(rank - step - 1, size);
+        std::vector<uint8_t> incoming;
+        Exchange(right, blocks[send_idx].ptr, blocks[send_idx].len, left,
+                 &incoming);
+        blocks[recv_idx].own = std::move(incoming);
+        blocks[recv_idx].ptr = blocks[recv_idx].own.data();
+        blocks[recv_idx].len = blocks[recv_idx].own.size();
+      }
+    }
+    size_t total = 0;
+    for (auto& b : blocks) total += b.len;
+    std::vector<uint8_t> result(total);
+    size_t off = 0;
+    for (auto& b : blocks) {
+      if (b.len) std::memcpy(result.data() + off, b.ptr, b.len);
+      off += b.len;
+    }
+    ReleaseName(e.name);
+    if (e.handle >= 0)
+      handles_.MarkDone(e.handle, Status::OK(), std::move(result));
+  }
+}
+
+void Engine::DoBroadcast(std::vector<TensorTableEntry>& entries,
+                         const Response& resp) {
+  int size = cfg_.size, rank = cfg_.rank;
+  for (auto& e : entries) {
+    int root = resp.tensor_sizes.empty()
+                   ? e.request.root_rank
+                   : static_cast<int>(resp.tensor_sizes[0]);
+    size_t nbytes = e.nelems * ItemSize(resp.tensor_type);
+    if (size > 1) {
+      if (rank == root) {
+        std::vector<int> others;
+        for (int r = 0; r < size; ++r)
+          if (r != root) others.push_back(data_fds_[r]);
+        MultiSend(others, e.data, nbytes);
+      } else {
+        std::vector<uint8_t> payload;
+        uint8_t tag = RecvFrame(data_fds_[root], &payload);
+        if (tag != kTagData)
+          throw SocketError("broadcast expected data frame");
+        // A joined stand-in has no caller buffer; the payload is dropped.
+        if (e.data && e.nelems)
+          std::memcpy(e.data, payload.data(),
+                      std::min(payload.size(), nbytes));
+      }
+    }
+    ReleaseName(e.name);
+    if (e.handle >= 0) handles_.MarkDone(e.handle, Status::OK());
+  }
+}
+
+void Engine::DoAlltoall(std::vector<TensorTableEntry>& entries,
+                        const Response& resp) {
+  // Pairwise exchange rounds (parity: cpu_backend.alltoall).
+  int size = cfg_.size, rank = cfg_.rank;
+  for (auto& e : entries) {
+    size_t isz = ItemSize(resp.tensor_type);
+    int64_t dim0 = e.request.tensor_shape.dims.empty()
+                       ? 0
+                       : e.request.tensor_shape.dims[0];
+    int64_t row_elems = dim0 > 0 ? e.nelems / dim0 : 0;
+    size_t row_bytes = row_elems * isz;
+    std::vector<int64_t> splits = e.splits;
+    if (splits.empty()) {
+      int64_t per = size > 0 ? dim0 / size : 0;
+      splits.assign(size, per);
+    }
+    std::vector<int64_t> offs{0};
+    for (auto s : splits) offs.push_back(offs.back() + s);
+
+    std::vector<std::vector<uint8_t>> recv_blocks(size);
+    std::vector<int64_t> recv_rows(size, 0);
+    recv_blocks[rank].assign(
+        e.data + offs[rank] * row_bytes,
+        e.data + offs[rank + 1] * row_bytes);
+    recv_rows[rank] = splits[rank];
+    for (int step = 1; step < size; ++step) {
+      int dst = Mod(rank + step, size);
+      int src = Mod(rank - step, size);
+      std::vector<uint8_t> incoming;
+      Exchange(data_fds_[dst], e.data + offs[dst] * row_bytes,
+               splits[dst] * row_bytes, data_fds_[src], &incoming);
+      recv_rows[src] =
+          row_bytes ? static_cast<int64_t>(incoming.size() / row_bytes) : 0;
+      recv_blocks[src] = std::move(incoming);
+    }
+    size_t total = 0;
+    for (auto& b : recv_blocks) total += b.size();
+    std::vector<uint8_t> result(total);
+    size_t off = 0;
+    for (auto& b : recv_blocks) {
+      if (!b.empty()) std::memcpy(result.data() + off, b.data(), b.size());
+      off += b.size();
+    }
+    ReleaseName(e.name);
+    if (e.handle >= 0)
+      handles_.MarkDone(e.handle, Status::OK(), std::move(result),
+                        std::move(recv_rows));
+  }
+}
+
+void Engine::DoBarrier() {
+  int32_t zero = 0;
+  RingAllreduceFlat(reinterpret_cast<uint8_t*>(&zero), 1, DataType::INT32,
+                    ReduceOp::SUM);
+}
+
+void Engine::Abort(const std::string& reason) {
+  (void)reason;
+  aborted_.store(true);
+  shutdown_.store(true);
+}
+
+}  // namespace hvd

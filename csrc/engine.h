@@ -1,0 +1,195 @@
+// The native coordination engine: background thread + star controller +
+// ring data plane over a TCP full mesh.
+//
+// Behavioral parity map (reference → here), mirroring the Python engine in
+// horovod_tpu/runtime_py.py which is the executable spec:
+//   horovod/common/operations.cc:333-589 BackgroundThreadLoop/RunLoopOnce
+//       → Engine::BackgroundLoop / RunLoopOnce
+//   horovod/common/controller.cc:62-354 ComputeResponseList
+//       → Engine::CoordinatorCycle (rank-0 message table)
+//   horovod/common/controller.cc:376-609 ConstructResponse
+//       → Engine::ConstructResponse
+//   horovod/common/controller.cc:638-759 FuseResponses
+//       → Engine::FuseResponses
+//   horovod/common/tensor_queue.cc → request_queue_/table_/name guard
+//   horovod/common/stall_inspector.cc → Engine::CheckStalls
+//   horovod/torch/handle_manager.h → HandleManager
+//   horovod/common/ops/gloo_operations.cc (CPU ring data plane)
+//       → Engine::RingAllreduce / RingAllgather / ... below
+//
+// Process bootstrap (rendezvous, socket dialing) stays in Python — it is
+// cold-path host traffic; the connected fds are handed to this engine which
+// owns them from then on.  Everything after init runs without the GIL.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "types.h"
+#include "wire.h"
+
+namespace hvd {
+
+struct HandleState {
+  bool done = false;
+  Status status;
+  // Result storage for ops whose output size is negotiated (allgather,
+  // alltoall).  Allreduce/broadcast write in place into the caller buffer.
+  std::vector<uint8_t> result;
+  std::vector<int64_t> recv_splits;
+};
+
+class HandleManager {
+ public:
+  int64_t Allocate();
+  void MarkDone(int64_t h, Status status, std::vector<uint8_t> result = {},
+                std::vector<int64_t> splits = {});
+  int Poll(int64_t h);  // 1 done, 0 pending, -1 unknown
+  // Blocks until done; returns the status type.
+  StatusType Wait(int64_t h);
+  HandleState* Get(int64_t h);  // valid until Release
+  void Release(int64_t h);
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_ = 0;
+  std::unordered_map<int64_t, HandleState> states_;
+};
+
+struct TensorTableEntry {
+  std::string name;
+  uint8_t* data = nullptr;       // caller buffer (in/out), or stand-in
+  std::vector<uint8_t> standin;  // owned zero buffer for joined ranks
+  int64_t nelems = 0;
+  int64_t handle = -1;  // -1 => join stand-in, no completion
+  Request request;
+  std::vector<int64_t> splits;  // alltoall only
+  double enqueue_s = 0;
+};
+
+struct EngineConfig {
+  int rank = 0;
+  int size = 1;
+  int local_rank = 0;
+  int local_size = 1;
+  int cross_rank = 0;
+  int cross_size = 1;
+  double cycle_time_s = 0.001;
+  int64_t fusion_threshold = 64 << 20;
+  double stall_warn_s = 60.0;
+  double stall_shutdown_s = 0.0;
+  bool stall_check_disable = false;
+};
+
+class Engine {
+ public:
+  // data_fds: one per rank (self = -1), full mesh.
+  // ctrl_fds: coordinator: fd per worker rank (index 0 unused = -1);
+  //           workers: index 0 = fd to the coordinator.
+  Engine(const EngineConfig& cfg, std::vector<int> data_fds,
+         std::vector<int> ctrl_fds);
+  ~Engine();
+
+  // Enqueue APIs; return handle or -1 with *err filled.
+  int64_t EnqueueAllreduce(const std::string& name, void* buf,
+                           const TensorShape& shape, DataType dt, ReduceOp op,
+                           double prescale, double postscale,
+                           std::string* err);
+  int64_t EnqueueAllgather(const std::string& name, const void* buf,
+                           const TensorShape& shape, DataType dt,
+                           std::string* err);
+  int64_t EnqueueBroadcast(const std::string& name, void* buf,
+                           const TensorShape& shape, DataType dt,
+                           int root_rank, std::string* err);
+  int64_t EnqueueAlltoall(const std::string& name, const void* buf,
+                          const TensorShape& shape, DataType dt,
+                          const std::vector<int64_t>& splits,
+                          std::string* err);
+
+  int Barrier(std::string* err);  // blocking; 0 ok
+  int Join();                     // blocking; returns last joined rank
+
+  HandleManager& handles() { return handles_; }
+  const EngineConfig& config() const { return cfg_; }
+  void Shutdown();
+  bool aborted() const { return aborted_.load(); }
+
+ private:
+  int64_t Enqueue(TensorTableEntry entry, std::string* err);
+  bool ClaimName(const std::string& name, std::string* err);
+  void ReleaseName(const std::string& name);
+
+  void BackgroundLoop();
+  bool RunLoopOnce();
+  bool WorkerCycle(std::vector<Request> msgs);
+  bool CoordinatorCycle(std::vector<Request> msgs);
+  void AbsorbRequest(const Request& req, std::vector<std::string>* ready);
+  Response ConstructResponse(const std::string& name,
+                             const std::vector<Request>& reqs);
+  std::vector<Response> FuseResponses(std::vector<Response> responses);
+  bool CheckStalls();
+  void DrainOnShutdown();
+  void Abort(const std::string& reason);
+
+  // Execution.
+  std::vector<TensorTableEntry> GetEntries(const Response& resp);
+  void PerformResponse(const Response& resp);
+  void DoAllreduce(std::vector<TensorTableEntry>& entries,
+                   const Response& resp);
+  void DoAllgather(std::vector<TensorTableEntry>& entries,
+                   const Response& resp);
+  void DoBroadcast(std::vector<TensorTableEntry>& entries,
+                   const Response& resp);
+  void DoAlltoall(std::vector<TensorTableEntry>& entries,
+                  const Response& resp);
+  void DoBarrier();
+
+  // Data plane.
+  void RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
+                         ReduceOp op);
+  void AdasumFlat(uint8_t* buf, int64_t nelems, DataType dt);
+
+  EngineConfig cfg_;
+  std::vector<int> data_fds_;
+  std::vector<int> ctrl_fds_;
+  HandleManager handles_;
+
+  std::mutex queue_mu_;
+  std::vector<Request> request_queue_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::unordered_set<std::string> pending_names_;
+  bool joined_ = false;
+  int64_t join_handle_ = -1;
+  std::atomic<int> last_joined_rank_{-1};
+
+  // Coordinator state (rank 0 only).
+  struct MessageTableEntry {
+    std::vector<Request> requests;
+    double first_seen_s = 0;
+  };
+  std::map<std::string, MessageTableEntry> msg_table_;
+  std::set<int> joined_ranks_;
+  double last_stall_check_s_ = 0;
+
+  // Fusion scratch (parity: fusion_buffer_manager.cc — one lazily grown
+  // persistent buffer reused across fused launches).
+  std::vector<uint8_t> fusion_buffer_;
+
+  std::atomic<bool> shutdown_{false};
+  std::atomic<bool> aborted_{false};
+  std::atomic<int64_t> barrier_counter_{0};
+  std::thread bg_;
+};
+
+}  // namespace hvd

@@ -1,0 +1,441 @@
+#include "kernels.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+namespace hvd {
+
+float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t out;
+  if (exp == 0) {
+    if (mant == 0) {
+      out = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3ffu;
+      out = sign | ((112 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    out = sign | 0x7f800000u | (mant << 13);
+  } else {
+    out = sign | ((exp + 112) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &out, 4);
+  return f;
+}
+
+uint16_t FloatToHalf(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint32_t sign = (u >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((u >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = u & 0x7fffffu;
+  if (((u >> 23) & 0xff) == 0xff) {  // inf / nan
+    return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0));
+  }
+  if (exp >= 31) {  // overflow -> inf
+    return static_cast<uint16_t>(sign | 0x7c00u);
+  }
+  if (exp <= 0) {  // subnormal or underflow
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    int shift = 14 - exp;
+    uint32_t sub = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (sub & 1))) ++sub;  // RNE
+    return static_cast<uint16_t>(sign | sub);
+  }
+  uint32_t out = sign | (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (out & 1))) ++out;  // RNE
+  return static_cast<uint16_t>(out);
+}
+
+uint16_t FloatToBf16(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  if ((u & 0x7f800000u) == 0x7f800000u && (u & 0x7fffffu)) {
+    return static_cast<uint16_t>((u >> 16) | 0x40);  // quiet the NaN
+  }
+  uint32_t lsb = (u >> 16) & 1;
+  u += 0x7fffu + lsb;  // round to nearest even
+  return static_cast<uint16_t>(u >> 16);
+}
+
+namespace {
+
+template <typename T>
+void CombineTyped(T* dst, const T* in, size_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+      for (size_t i = 0; i < n; ++i) dst[i] = in[i] + dst[i];
+      break;
+    case ReduceOp::MIN:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::min(in[i], dst[i]);
+      break;
+    case ReduceOp::MAX:
+      for (size_t i = 0; i < n; ++i) dst[i] = std::max(in[i], dst[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (size_t i = 0; i < n; ++i) dst[i] = in[i] * dst[i];
+      break;
+  }
+}
+
+float CombineF32(float a, float b, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+      return a + b;
+    case ReduceOp::MIN:
+      return std::min(a, b);
+    case ReduceOp::MAX:
+      return std::max(a, b);
+    case ReduceOp::PRODUCT:
+      return a * b;
+  }
+  return a + b;
+}
+
+void CombineBool(uint8_t* dst, const uint8_t* in, size_t n, ReduceOp op) {
+  // numpy bool arithmetic: + is OR, * is AND, min/max likewise.
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::AVERAGE:
+    case ReduceOp::ADASUM:
+    case ReduceOp::MAX:
+      for (size_t i = 0; i < n; ++i) dst[i] = (in[i] || dst[i]) ? 1 : 0;
+      break;
+    case ReduceOp::MIN:
+    case ReduceOp::PRODUCT:
+      for (size_t i = 0; i < n; ++i) dst[i] = (in[i] && dst[i]) ? 1 : 0;
+      break;
+  }
+}
+
+}  // namespace
+
+void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
+                 ReduceOp op) {
+  switch (dt) {
+    case DataType::UINT8:
+      CombineTyped(static_cast<uint8_t*>(dst),
+                   static_cast<const uint8_t*>(incoming), n, op);
+      break;
+    case DataType::INT8:
+      CombineTyped(static_cast<int8_t*>(dst),
+                   static_cast<const int8_t*>(incoming), n, op);
+      break;
+    case DataType::UINT16:
+      CombineTyped(static_cast<uint16_t*>(dst),
+                   static_cast<const uint16_t*>(incoming), n, op);
+      break;
+    case DataType::INT16:
+      CombineTyped(static_cast<int16_t*>(dst),
+                   static_cast<const int16_t*>(incoming), n, op);
+      break;
+    case DataType::INT32:
+      CombineTyped(static_cast<int32_t*>(dst),
+                   static_cast<const int32_t*>(incoming), n, op);
+      break;
+    case DataType::INT64:
+      CombineTyped(static_cast<int64_t*>(dst),
+                   static_cast<const int64_t*>(incoming), n, op);
+      break;
+    case DataType::FLOAT32:
+      CombineTyped(static_cast<float*>(dst),
+                   static_cast<const float*>(incoming), n, op);
+      break;
+    case DataType::FLOAT64:
+      CombineTyped(static_cast<double*>(dst),
+                   static_cast<const double*>(incoming), n, op);
+      break;
+    case DataType::BOOL:
+      CombineBool(static_cast<uint8_t*>(dst),
+                  static_cast<const uint8_t*>(incoming), n, op);
+      break;
+    case DataType::FLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      auto* s = static_cast<const uint16_t*>(incoming);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToHalf(
+            CombineF32(HalfToFloat(s[i]), HalfToFloat(d[i]), op));
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      auto* s = static_cast<const uint16_t*>(incoming);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToBf16(
+            CombineF32(Bf16ToFloat(s[i]), Bf16ToFloat(d[i]), op));
+      break;
+    }
+  }
+}
+
+namespace {
+
+template <typename T>
+void ScaleTyped(T* buf, size_t n, double factor) {
+  for (size_t i = 0; i < n; ++i)
+    buf[i] = static_cast<T>(buf[i] * static_cast<T>(factor));
+}
+
+}  // namespace
+
+void ScaleInPlace(void* buf, size_t n, DataType dt, double factor) {
+  switch (dt) {
+    case DataType::FLOAT32:
+      ScaleTyped(static_cast<float*>(buf), n, factor);
+      break;
+    case DataType::FLOAT64:
+      ScaleTyped(static_cast<double*>(buf), n, factor);
+      break;
+    case DataType::FLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToHalf(HalfToFloat(b[i]) * f);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToBf16(Bf16ToFloat(b[i]) * f);
+      break;
+    }
+    case DataType::INT32: {
+      auto* b = static_cast<int32_t*>(buf);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = static_cast<int32_t>(b[i] * factor);
+      break;
+    }
+    case DataType::INT64: {
+      auto* b = static_cast<int64_t*>(buf);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = static_cast<int64_t>(b[i] * factor);
+      break;
+    }
+    default: {
+      // Small integer types: scale through double, truncate like numpy's
+      // astype after float multiply.
+      size_t isz = ItemSize(dt);
+      auto* b = static_cast<uint8_t*>(buf);
+      for (size_t i = 0; i < n; ++i) {
+        double v = 0;
+        switch (dt) {
+          case DataType::UINT8: v = b[i]; break;
+          case DataType::INT8: v = reinterpret_cast<int8_t*>(b)[i]; break;
+          case DataType::UINT16:
+            v = reinterpret_cast<uint16_t*>(b)[i];
+            break;
+          case DataType::INT16:
+            v = reinterpret_cast<int16_t*>(b)[i];
+            break;
+          case DataType::BOOL: v = b[i]; break;
+          default: break;
+        }
+        v *= factor;
+        switch (dt) {
+          case DataType::UINT8: b[i] = static_cast<uint8_t>(v); break;
+          case DataType::INT8:
+            reinterpret_cast<int8_t*>(b)[i] = static_cast<int8_t>(v);
+            break;
+          case DataType::UINT16:
+            reinterpret_cast<uint16_t*>(b)[i] = static_cast<uint16_t>(v);
+            break;
+          case DataType::INT16:
+            reinterpret_cast<int16_t*>(b)[i] = static_cast<int16_t>(v);
+            break;
+          case DataType::BOOL: b[i] = v != 0; break;
+          default: break;
+        }
+      }
+      (void)isz;
+      break;
+    }
+  }
+}
+
+void AverageInPlace(void* buf, size_t n, DataType dt, int64_t world_size) {
+  switch (dt) {
+    case DataType::FLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      float inv = static_cast<float>(world_size);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToHalf(HalfToFloat(b[i]) / inv);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* b = static_cast<uint16_t*>(buf);
+      float inv = static_cast<float>(world_size);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToBf16(Bf16ToFloat(b[i]) / inv);
+      break;
+    }
+    case DataType::FLOAT32: {
+      auto* b = static_cast<float*>(buf);
+      float w = static_cast<float>(world_size);
+      for (size_t i = 0; i < n; ++i) b[i] = b[i] / w;
+      break;
+    }
+    case DataType::FLOAT64: {
+      auto* b = static_cast<double*>(buf);
+      double w = static_cast<double>(world_size);
+      for (size_t i = 0; i < n; ++i) b[i] = b[i] / w;
+      break;
+    }
+    default:
+      // Integer average: floor-divide (documented divergence from the
+      // Python engine, which promotes to float64; averaging integers is
+      // rejected at the API layer anyway).
+      ScaleInPlace(buf, n, dt, 1.0 / static_cast<double>(world_size));
+      break;
+  }
+}
+
+void AdasumPairF64(const double* a, const double* b, double* out, size_t n) {
+  double dot = 0, an = 0, bn = 0;
+  for (size_t i = 0; i < n; ++i) {
+    dot += a[i] * b[i];
+    an += a[i] * a[i];
+    bn += b[i] * b[i];
+  }
+  double acoef = an > 0 ? 1.0 - dot / (2.0 * an) : 1.0;
+  double bcoef = bn > 0 ? 1.0 - dot / (2.0 * bn) : 1.0;
+  for (size_t i = 0; i < n; ++i) out[i] = acoef * a[i] + bcoef * b[i];
+}
+
+void ToF64(const void* src, double* dst, size_t n, DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: {
+      auto* s = static_cast<const uint8_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::INT8: {
+      auto* s = static_cast<const int8_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::UINT16: {
+      auto* s = static_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::INT16: {
+      auto* s = static_cast<const int16_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::INT32: {
+      auto* s = static_cast<const int32_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::INT64: {
+      auto* s = static_cast<const int64_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = static_cast<double>(s[i]);
+      break;
+    }
+    case DataType::FLOAT16: {
+      auto* s = static_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = HalfToFloat(s[i]);
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* s = static_cast<const uint16_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = Bf16ToFloat(s[i]);
+      break;
+    }
+    case DataType::FLOAT32: {
+      auto* s = static_cast<const float*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i];
+      break;
+    }
+    case DataType::FLOAT64:
+      std::memcpy(dst, src, n * 8);
+      break;
+    case DataType::BOOL: {
+      auto* s = static_cast<const uint8_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = s[i] ? 1.0 : 0.0;
+      break;
+    }
+  }
+}
+
+void FromF64(const double* src, void* dst, size_t n, DataType dt) {
+  switch (dt) {
+    case DataType::UINT8: {
+      auto* d = static_cast<uint8_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<uint8_t>(src[i]);
+      break;
+    }
+    case DataType::INT8: {
+      auto* d = static_cast<int8_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<int8_t>(src[i]);
+      break;
+    }
+    case DataType::UINT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<uint16_t>(src[i]);
+      break;
+    }
+    case DataType::INT16: {
+      auto* d = static_cast<int16_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<int16_t>(src[i]);
+      break;
+    }
+    case DataType::INT32: {
+      auto* d = static_cast<int32_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<int32_t>(src[i]);
+      break;
+    }
+    case DataType::INT64: {
+      auto* d = static_cast<int64_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<int64_t>(src[i]);
+      break;
+    }
+    case DataType::FLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToHalf(static_cast<float>(src[i]));
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToBf16(static_cast<float>(src[i]));
+      break;
+    }
+    case DataType::FLOAT32: {
+      auto* d = static_cast<float*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = static_cast<float>(src[i]);
+      break;
+    }
+    case DataType::FLOAT64:
+      std::memcpy(dst, src, n * 8);
+      break;
+    case DataType::BOOL: {
+      auto* d = static_cast<uint8_t*>(dst);
+      for (size_t i = 0; i < n; ++i) d[i] = src[i] != 0.0;
+      break;
+    }
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,188 @@
+#include "wire.h"
+
+#include <cstring>
+
+namespace hvd {
+namespace {
+
+// Little-endian primitive writers.  x86/ARM targets are all LE; we still
+// write bytewise so the codec is endian-agnostic.
+void PutU8(std::vector<uint8_t>& b, uint8_t v) { b.push_back(v); }
+
+void PutU32(std::vector<uint8_t>& b, uint32_t v) {
+  for (int i = 0; i < 4; ++i) b.push_back((v >> (8 * i)) & 0xff);
+}
+
+void PutI32(std::vector<uint8_t>& b, int32_t v) {
+  PutU32(b, static_cast<uint32_t>(v));
+}
+
+void PutI64(std::vector<uint8_t>& b, int64_t v) {
+  auto u = static_cast<uint64_t>(v);
+  for (int i = 0; i < 8; ++i) b.push_back((u >> (8 * i)) & 0xff);
+}
+
+void PutF64(std::vector<uint8_t>& b, double v) {
+  uint64_t u;
+  std::memcpy(&u, &v, 8);
+  for (int i = 0; i < 8; ++i) b.push_back((u >> (8 * i)) & 0xff);
+}
+
+void PutStr(std::vector<uint8_t>& b, const std::string& s) {
+  PutU32(b, static_cast<uint32_t>(s.size()));
+  b.insert(b.end(), s.begin(), s.end());
+}
+
+struct Reader {
+  const uint8_t* data;
+  size_t len;
+  size_t off = 0;
+  bool fail = false;
+
+  bool Need(size_t n) {
+    if (off + n > len) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  uint8_t U8() {
+    if (!Need(1)) return 0;
+    return data[off++];
+  }
+  uint32_t U32() {
+    if (!Need(4)) return 0;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= uint32_t(data[off + i]) << (8 * i);
+    off += 4;
+    return v;
+  }
+  int32_t I32() { return static_cast<int32_t>(U32()); }
+  int64_t I64() {
+    if (!Need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= uint64_t(data[off + i]) << (8 * i);
+    off += 8;
+    return static_cast<int64_t>(v);
+  }
+  double F64() {
+    uint64_t u = static_cast<uint64_t>(I64());
+    double v;
+    std::memcpy(&v, &u, 8);
+    return v;
+  }
+  std::string Str() {
+    uint32_t n = U32();
+    if (!Need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(data + off), n);
+    off += n;
+    return s;
+  }
+};
+
+void EncodeRequest(const Request& r, std::vector<uint8_t>& b) {
+  PutU8(b, static_cast<uint8_t>(r.request_type));
+  PutI32(b, r.request_rank);
+  PutU8(b, static_cast<uint8_t>(r.tensor_type));
+  PutStr(b, r.tensor_name);
+  PutI32(b, r.root_rank);
+  PutStr(b, r.device);
+  PutU8(b, static_cast<uint8_t>(r.reduce_op));
+  PutF64(b, r.prescale_factor);
+  PutF64(b, r.postscale_factor);
+  PutU8(b, static_cast<uint8_t>(r.tensor_shape.dims.size()));
+  for (auto d : r.tensor_shape.dims) PutI64(b, d);
+}
+
+Request DecodeRequest(Reader& rd) {
+  Request r;
+  r.request_type = static_cast<RequestType>(rd.U8());
+  r.request_rank = rd.I32();
+  r.tensor_type = static_cast<DataType>(rd.U8());
+  r.tensor_name = rd.Str();
+  r.root_rank = rd.I32();
+  r.device = rd.Str();
+  r.reduce_op = static_cast<ReduceOp>(rd.U8());
+  r.prescale_factor = rd.F64();
+  r.postscale_factor = rd.F64();
+  uint8_t ndim = rd.U8();
+  for (uint8_t i = 0; i < ndim; ++i) r.tensor_shape.dims.push_back(rd.I64());
+  return r;
+}
+
+void EncodeResponse(const Response& r, std::vector<uint8_t>& b) {
+  PutU8(b, static_cast<uint8_t>(r.response_type));
+  PutU8(b, static_cast<uint8_t>(r.tensor_type));
+  PutU32(b, static_cast<uint32_t>(r.tensor_names.size()));
+  for (auto& nm : r.tensor_names) PutStr(b, nm);
+  PutStr(b, r.error_message);
+  PutU32(b, static_cast<uint32_t>(r.devices.size()));
+  for (auto& d : r.devices) PutStr(b, d);
+  PutU32(b, static_cast<uint32_t>(r.tensor_sizes.size()));
+  for (auto s : r.tensor_sizes) PutI64(b, s);
+  PutU8(b, static_cast<uint8_t>(r.reduce_op));
+  PutF64(b, r.prescale_factor);
+  PutF64(b, r.postscale_factor);
+}
+
+Response DecodeResponse(Reader& rd) {
+  Response r;
+  r.response_type = static_cast<ResponseType>(rd.U8());
+  r.tensor_type = static_cast<DataType>(rd.U8());
+  uint32_t n_names = rd.U32();
+  for (uint32_t i = 0; i < n_names && !rd.fail; ++i)
+    r.tensor_names.push_back(rd.Str());
+  r.error_message = rd.Str();
+  uint32_t n_dev = rd.U32();
+  for (uint32_t i = 0; i < n_dev && !rd.fail; ++i)
+    r.devices.push_back(rd.Str());
+  uint32_t n_sizes = rd.U32();
+  for (uint32_t i = 0; i < n_sizes && !rd.fail; ++i)
+    r.tensor_sizes.push_back(rd.I64());
+  r.reduce_op = static_cast<ReduceOp>(rd.U8());
+  r.prescale_factor = rd.F64();
+  r.postscale_factor = rd.F64();
+  return r;
+}
+
+}  // namespace
+
+std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
+                                       bool shutdown) {
+  std::vector<uint8_t> b;
+  PutU8(b, shutdown ? 1 : 0);
+  PutU32(b, static_cast<uint32_t>(reqs.size()));
+  for (auto& r : reqs) EncodeRequest(r, b);
+  return b;
+}
+
+bool DecodeRequestList(const uint8_t* data, size_t len,
+                       std::vector<Request>* out, bool* shutdown) {
+  Reader rd{data, len};
+  *shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  for (uint32_t i = 0; i < n && !rd.fail; ++i)
+    out->push_back(DecodeRequest(rd));
+  return !rd.fail;
+}
+
+std::vector<uint8_t> EncodeResponseList(const std::vector<Response>& resps,
+                                        bool shutdown) {
+  std::vector<uint8_t> b;
+  PutU8(b, shutdown ? 1 : 0);
+  PutU32(b, static_cast<uint32_t>(resps.size()));
+  for (auto& r : resps) EncodeResponse(r, b);
+  return b;
+}
+
+bool DecodeResponseList(const uint8_t* data, size_t len,
+                        std::vector<Response>* out, bool* shutdown) {
+  Reader rd{data, len};
+  *shutdown = rd.U8() != 0;
+  uint32_t n = rd.U32();
+  for (uint32_t i = 0; i < n && !rd.fail; ++i)
+    out->push_back(DecodeResponse(rd));
+  return !rd.fail;
+}
+
+}  // namespace hvd

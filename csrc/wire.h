@@ -1,0 +1,59 @@
+// Binary wire codec for controller messages.
+//
+// Role parity: horovod/common/wire/message.fbs + message.cc (the reference
+// uses FlatBuffers).  The layout here is the hand-rolled little-endian
+// encoding specified in horovod_tpu/common/wire.py — THE TWO MUST MATCH;
+// both engines speak this format on the same sockets.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "types.h"
+
+namespace hvd {
+
+// What one rank wants to do with one named tensor.
+// Parity: message.h:47-100 + prescale/postscale from the torch v2 path.
+struct Request {
+  int32_t request_rank = 0;
+  RequestType request_type = RequestType::ALLREDUCE;
+  DataType tensor_type = DataType::FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = -1;
+  std::string device = "cpu";
+  TensorShape tensor_shape;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+};
+
+// What every rank must now execute, in identical order.
+// Parity: message.h:132-192.
+struct Response {
+  ResponseType response_type = ResponseType::ERROR;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  std::vector<std::string> devices;
+  DataType tensor_type = DataType::FLOAT32;
+  std::vector<int64_t> tensor_sizes;
+  // Allreduce execution parameters negotiated from the requests; fusion
+  // only merges responses where these match.
+  ReduceOp reduce_op = ReduceOp::SUM;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+};
+
+std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
+                                       bool shutdown);
+// Returns false on malformed input.
+bool DecodeRequestList(const uint8_t* data, size_t len,
+                       std::vector<Request>* out, bool* shutdown);
+
+std::vector<uint8_t> EncodeResponseList(const std::vector<Response>& resps,
+                                        bool shutdown);
+bool DecodeResponseList(const uint8_t* data, size_t len,
+                        std::vector<Response>* out, bool* shutdown);
+
+}  // namespace hvd

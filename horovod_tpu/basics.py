@@ -100,14 +100,21 @@ def _make_engine(r, s, lr, ls, cr, cs):
     port = int(os.environ.get(_Env.RENDEZVOUS_PORT, "0"))
     try:
         from horovod_tpu.runtime_native import NativeEngine
+        from horovod_tpu import native
 
-        return NativeEngine(r, s, lr, ls, cr, cs, addr, port)
-    except (ImportError, OSError) as e:
+        native.load()  # raises NativeUnavailable before any rendezvous
+    except ImportError as e:
+        # Only pre-bootstrap failures (no toolchain / forced via
+        # HVD_TPU_CORE=py) fall back.  Failures after the mesh is wired
+        # must fail fast — peers have already consumed this rank's
+        # rendezvous address, so silently re-bootstrapping under a
+        # different engine would hang the whole job.
         from horovod_tpu.runtime_py import PyEngine
 
         eng = PyEngine(r, s, lr, ls, cr, cs, addr, port)
         eng.native_fallback_reason = str(e)
         return eng
+    return NativeEngine(r, s, lr, ls, cr, cs, addr, port)
 
 
 def _engine():

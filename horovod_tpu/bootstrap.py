@@ -1,0 +1,99 @@
+"""Process-group socket bootstrap, shared by the Python and native engines.
+
+Builds the TCP topology both engines run on:
+
+* a full **data mesh** (one socket per peer pair) for the ring data plane,
+* a **control star** (worker -> rank 0) for the request/response protocol.
+
+Rank addresses rendezvous through the launcher's HTTP KV store, mirroring
+the reference's gloo rendezvous (``gloo_context.cc:56-76`` against
+``run/http/http_server.py``).  This is cold-path host traffic, so it stays
+in Python even for the native engine — the connected fds are handed to the
+C++ core afterwards (``csrc/engine.h``), which owns them from then on.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import socketutil as su
+
+
+def bootstrap_mesh(
+    rank: int,
+    size: int,
+    rdv_addr: str,
+    rdv_port: int,
+) -> Tuple[Dict[int, socket.socket], Optional[socket.socket],
+           Dict[int, socket.socket]]:
+    """Returns ``(data, ctrl_sock, ctrl_socks)``:
+
+    * ``data``: peer rank -> connected data socket (full mesh),
+    * ``ctrl_sock``: worker's connection to the coordinator (None on rank 0),
+    * ``ctrl_socks``: coordinator's per-worker sockets (empty off rank 0).
+    """
+    from horovod_tpu.runner.http_client import KVClient
+
+    # Launcher-provided startup budget (hvdrun --start-timeout);
+    # parity: HOROVOD_GLOO_TIMEOUT_SECONDS (gloo_context.cc:38-40).
+    start_timeout = env_util.get_float("HVD_START_TIMEOUT", 120.0)
+    kv = KVClient(rdv_addr, rdv_port)
+    listener = su.listen_on()
+    port = listener.getsockname()[1]
+    # Learn the address peers can reach us at from the route the rendezvous
+    # connection takes (works multi-host without NIC configuration).
+    my_host = kv.local_address() or "127.0.0.1"
+    kv.put(f"hvd/addr/{rank}", f"{my_host}:{port}")
+    peers = {}
+    for i in range(size):
+        if i == rank:
+            continue
+        v = kv.wait_get(f"hvd/addr/{i}", timeout=start_timeout)
+        host, p = v.rsplit(":", 1)
+        peers[i] = (host, int(p))
+
+    # A rank connects to every lower rank and accepts from every higher
+    # one; workers additionally dial a ctrl connection to rank 0.
+    data: Dict[int, socket.socket] = {}
+    ctrl_sock: Optional[socket.socket] = None
+    ctrl_socks: Dict[int, socket.socket] = {}
+
+    n_accept = size - 1 - rank
+    if rank == 0:
+        n_accept += size - 1  # ctrl connections
+    accept_results: Dict[Tuple[int, int], socket.socket] = {}
+
+    def _accept_loop():
+        for _ in range(n_accept):
+            s, _addr = listener.accept()
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hdr = su.recv_exact(s, 8)
+            peer_rank, chan = struct.unpack("<ii", hdr)
+            accept_results[(peer_rank, chan)] = s
+
+    acceptor = threading.Thread(target=_accept_loop, daemon=True)
+    acceptor.start()
+
+    for j in range(rank):
+        s = su.connect_retry(*peers[j], timeout=start_timeout)
+        s.sendall(struct.pack("<ii", rank, 0))
+        data[j] = s
+    if rank != 0:
+        s = su.connect_retry(*peers[0], timeout=start_timeout)
+        s.sendall(struct.pack("<ii", rank, 1))
+        ctrl_sock = s
+
+    acceptor.join(timeout=start_timeout * 1.5)
+    if acceptor.is_alive():
+        raise ConnectionError("timed out waiting for peer connections")
+    for (peer_rank, chan), s in accept_results.items():
+        if chan == 0:
+            data[peer_rank] = s
+        else:
+            ctrl_socks[peer_rank] = s
+    listener.close()
+    return data, ctrl_sock, ctrl_socks

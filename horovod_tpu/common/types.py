@@ -231,6 +231,13 @@ class Response:
     # by rank, one block per tensor.  For allreduce: total byte size of each
     # fused tensor (used to slice the fusion buffer).
     tensor_sizes: List[int] = field(default_factory=list)
+    # Allreduce execution parameters, negotiated from the (matching)
+    # requests.  Carried in the response so (a) fusion only merges
+    # allreduces with identical semantics and (b) joined ranks' zero
+    # stand-ins reduce with the right op.
+    reduce_op: ReduceOp = ReduceOp.SUM
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
 
     def add_tensor_name(self, name: str) -> None:
         self.tensor_names.append(name)

@@ -18,7 +18,8 @@ Layout (all integers little-endian):
   Response := u8 response_type, u8 tensor_type, u32 n_names,
               varstr[n_names], varstr error_message,
               u32 n_devices, varstr[n_devices],
-              u32 n_sizes, i64 sizes[n_sizes]
+              u32 n_sizes, i64 sizes[n_sizes],
+              u8 reduce_op, f64 prescale, f64 postscale
   ResponseList := u8 shutdown, u32 n, Response[n]
 """
 
@@ -125,6 +126,8 @@ def encode_response(resp: Response, buf: bytearray) -> None:
     buf += struct.pack("<I", len(resp.tensor_sizes))
     for s in resp.tensor_sizes:
         buf += struct.pack("<q", s)
+    buf += struct.pack("<Bdd", int(resp.reduce_op), resp.prescale_factor,
+                       resp.postscale_factor)
 
 
 def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
@@ -148,6 +151,8 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
         (s,) = struct.unpack_from("<q", data, off)
         off += 8
         sizes.append(s)
+    rop, pre, post = struct.unpack_from("<Bdd", data, off)
+    off += struct.calcsize("<Bdd")
     return Response(
         response_type=ResponseType(rtype),
         tensor_type=DataType(ttype),
@@ -155,6 +160,9 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
         error_message=err,
         devices=devices,
         tensor_sizes=sizes,
+        reduce_op=ReduceOp(rop),
+        prescale_factor=pre,
+        postscale_factor=post,
     ), off
 
 

@@ -140,15 +140,12 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
 
 
 def allreduce(engine, entries, resp: Response):
-    """Fused allreduce over all entries of the response."""
-    op = ReduceOp.SUM
-    prescale = postscale = 1.0
-    for e in entries:
-        if e.handle >= 0:  # a real (non-stand-in) entry carries the op
-            op = e.request.reduce_op
-            prescale = e.request.prescale_factor
-            postscale = e.request.postscale_factor
-            break
+    """Fused allreduce over all entries of the response.  The op and the
+    scale factors come from the negotiated response (identical on every
+    rank, including joined ranks whose entries are zero stand-ins)."""
+    op = resp.reduce_op
+    prescale = resp.prescale_factor
+    postscale = resp.postscale_factor
     dtype = _np_dtype(resp.tensor_type)
     flats = [np.ravel(e.array).astype(dtype, copy=False) for e in entries]
     flat = flats[0] if len(flats) == 1 else np.concatenate(flats)

@@ -41,6 +41,13 @@ def _convert(dest: str, v) -> Optional[str]:
     if v is None or v is False:
         return None
     if dest in _BOOL:
+        # YAML may spell booleans as 0/1/"false"/"true"; only truthy
+        # values enable the feature (argparse store_true always passes
+        # the literal True here).
+        if isinstance(v, str):
+            v = v.strip().lower() not in ("", "0", "false", "no", "off")
+        if not v:
+            return None
         return "1"
     if dest in _MB:
         return str(int(float(v) * 1024 * 1024))

@@ -39,10 +39,15 @@ def from_tpu_metadata() -> Optional[PodTopology]:
     hostnames = env.get("TPU_WORKER_HOSTNAMES")
     if worker_id is None or hostnames is None:
         return None
-    local_rank = int(worker_id)
+    try:
+        local_rank = int(worker_id)
+        cross_rank = int(env.get("MEGASCALE_SLICE_ID", "0"))
+        cross_size = int(env.get("MEGASCALE_NUM_SLICES", "1"))
+    except ValueError:
+        # Malformed pod metadata (e.g. a k8s setup exporting a worker
+        # *name*): treat as "not on a pod" rather than crashing init().
+        return None
     local_size = len([h for h in hostnames.split(",") if h.strip()])
-    cross_rank = int(env.get("MEGASCALE_SLICE_ID", "0"))
-    cross_size = int(env.get("MEGASCALE_NUM_SLICES", "1"))
     return PodTopology(
         rank=cross_rank * local_size + local_rank,
         size=cross_size * local_size,

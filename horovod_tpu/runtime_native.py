@@ -1,0 +1,236 @@
+"""Python facade over the native C++ engine (``csrc/``).
+
+``NativeEngine`` exposes the same interface as ``runtime_py.PyEngine`` —
+``allreduce_async``/``allgather_async``/``broadcast_async``/
+``alltoall_async``/``poll``/``synchronize``/``barrier``/``join``/
+``shutdown`` — so ``horovod_tpu.ops.eager`` is engine-agnostic.  The two
+engines speak the same wire protocol (csrc/wire.cc ≡ common/wire.py) and
+run the same ring algorithms, so a job may mix them
+(``HVD_TPU_CORE=py`` on some ranks) and still reduce bit-identically;
+the multi-process tests exercise exactly that.
+
+Buffer ownership: allreduce/broadcast run **in place** on the enqueue-side
+numpy buffer (zero copy, like the reference's in-place torch path,
+mpi_ops_v2.cc DoAllreduce with output == input); this class keeps the array
+alive until its handle completes.  Allgather/alltoall outputs are sized by
+negotiation, so the core owns them until ``synchronize`` copies them out.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.common.types import (
+    DataType,
+    ReduceOp,
+    RequestType,
+    StatusType,
+    dtype_from_numpy,
+)
+from horovod_tpu.runtime_py import _np_dtype
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import timeline as timeline_mod
+
+
+@dataclass
+class _HandleMeta:
+    kind: RequestType
+    array: np.ndarray  # enqueue-side buffer, kept alive until completion
+    dtype: DataType
+    shape: tuple
+
+
+class NativeEngine:
+    """ctypes driver for the C++ engine; see module docstring."""
+
+    def __init__(self, rank, size, local_rank, local_size,
+                 cross_rank, cross_size, rdv_addr, rdv_port):
+        from horovod_tpu import native
+        from horovod_tpu.bootstrap import bootstrap_mesh
+
+        self._lib = native.load()
+        self.rank = rank
+        self.size = size
+        self.local_rank = local_rank
+        self.local_size = local_size
+        self.cross_rank = cross_rank
+        self.cross_size = cross_size
+        self.is_homogeneous = True
+        self.native_fallback_reason = None
+        self.timeline = timeline_mod.from_env(rank)
+
+        data, ctrl_sock, ctrl_socks = bootstrap_mesh(
+            rank, size, rdv_addr, rdv_port)
+
+        # Hand the connected fds to the core, which owns them from now on.
+        data_fds = (ctypes.c_int32 * size)(*[-1] * size)
+        ctrl_fds = (ctypes.c_int32 * size)(*[-1] * size)
+        for r, s in data.items():
+            data_fds[r] = s.detach()
+        if rank == 0:
+            for r, s in ctrl_socks.items():
+                ctrl_fds[r] = s.detach()
+        elif ctrl_sock is not None:
+            ctrl_fds[0] = ctrl_sock.detach()
+
+        rc = self._lib.hvd_create(
+            rank, size, local_rank, local_size, cross_rank, cross_size,
+            data_fds, ctrl_fds,
+            env_util.cycle_time_ms() / 1e3,
+            env_util.fusion_threshold_bytes(),
+            env_util.get_float(env_util.STALL_CHECK_TIME, 60.0),
+            env_util.get_float(env_util.STALL_SHUTDOWN_TIME, 0.0),
+            1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
+            else 0)
+        if rc != 0:
+            raise OSError(self._lib.hvd_last_error().decode())
+
+        self._meta: Dict[int, _HandleMeta] = {}
+        self._meta_lock = threading.Lock()
+        self._shutdown = False
+
+    # -- enqueue -----------------------------------------------------------
+
+    def _dims(self, arr: np.ndarray):
+        nd = max(arr.ndim, 1)
+        dims = (ctypes.c_int64 * nd)(*(arr.shape or (1,)))
+        return nd if arr.ndim else 0, dims
+
+    def _raise_enqueue_error(self):
+        msg = self._lib.hvd_last_error().decode()
+        # Argument errors mirror the Python engine's ValueError surface.
+        if any(k in msg for k in ("same name", "out of range", "splits",
+                                  "divisible")):
+            raise ValueError(msg)
+        raise RuntimeError(msg)
+
+    def allreduce_async(self, name, array, op=ReduceOp.SUM,
+                        prescale=1.0, postscale=1.0):
+        arr = np.ascontiguousarray(array)
+        if arr is array:  # in-place op: never clobber the caller's array
+            arr = arr.copy()
+        dt = dtype_from_numpy(arr.dtype)
+        nd, dims = self._dims(arr)
+        h = self._lib.hvd_allreduce_async(
+            name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
+            int(dt), int(op), prescale, postscale)
+        if h < 0:
+            self._raise_enqueue_error()
+        with self._meta_lock:
+            self._meta[h] = _HandleMeta(
+                RequestType.ALLREDUCE, arr, dt, arr.shape)
+        return h
+
+    def allgather_async(self, name, array):
+        arr = np.ascontiguousarray(array)
+        dt = dtype_from_numpy(arr.dtype)
+        nd, dims = self._dims(arr)
+        h = self._lib.hvd_allgather_async(
+            name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
+            int(dt))
+        if h < 0:
+            self._raise_enqueue_error()
+        with self._meta_lock:
+            self._meta[h] = _HandleMeta(
+                RequestType.ALLGATHER, arr, dt, arr.shape)
+        return h
+
+    def broadcast_async(self, name, array, root_rank=0):
+        arr = np.ascontiguousarray(array)
+        if arr is array:
+            arr = arr.copy()
+        dt = dtype_from_numpy(arr.dtype)
+        nd, dims = self._dims(arr)
+        h = self._lib.hvd_broadcast_async(
+            name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
+            int(dt), root_rank)
+        if h < 0:
+            self._raise_enqueue_error()
+        with self._meta_lock:
+            self._meta[h] = _HandleMeta(
+                RequestType.BROADCAST, arr, dt, arr.shape)
+        return h
+
+    def alltoall_async(self, name, array, splits: Optional[List[int]] = None):
+        arr = np.ascontiguousarray(array)
+        dt = dtype_from_numpy(arr.dtype)
+        nd, dims = self._dims(arr)
+        if splits is not None:
+            splits = [int(s) for s in splits]
+            if sum(splits) != (arr.shape[0] if arr.ndim else 0):
+                raise ValueError("splits must sum to dim 0")
+            carr = (ctypes.c_int64 * len(splits))(*splits)
+            h = self._lib.hvd_alltoall_async(
+                name.encode(), arr.ctypes.data, nd, dims, int(dt), carr,
+                len(splits))
+        else:
+            h = self._lib.hvd_alltoall_async(
+                name.encode(), arr.ctypes.data, nd, dims, int(dt), None, 0)
+        if h < 0:
+            self._raise_enqueue_error()
+        with self._meta_lock:
+            self._meta[h] = _HandleMeta(
+                RequestType.ALLTOALL, arr, dt, arr.shape)
+        return h
+
+    # -- completion --------------------------------------------------------
+
+    def poll(self, handle: int) -> bool:
+        rc = self._lib.hvd_poll(handle)
+        if rc < 0:
+            raise ValueError(f"unknown handle {handle}")
+        return rc == 1
+
+    def synchronize(self, handle: int, timeout=None):
+        st = StatusType(self._lib.hvd_wait(handle))
+        with self._meta_lock:
+            meta = self._meta.pop(handle, None)
+        if st != StatusType.OK:
+            msg = self._lib.hvd_handle_error(handle).decode()
+            self._lib.hvd_release(handle)
+            raise RuntimeError(msg or "collective failed")
+        try:
+            if meta is None:
+                return None
+            if meta.kind in (RequestType.ALLREDUCE, RequestType.BROADCAST):
+                return meta.array  # reduced/received in place
+            np_dt = _np_dtype(meta.dtype)
+            nbytes = self._lib.hvd_result_nbytes(handle)
+            src = self._lib.hvd_result_data(handle)
+            if nbytes <= 0 or src is None:
+                out = np.zeros((0,) + meta.shape[1:], np_dt)
+            else:
+                buf = ctypes.string_at(src, nbytes)
+                out = np.frombuffer(buf, dtype=np_dt).copy()
+                rest = meta.shape[1:]
+                out = out.reshape((-1,) + rest)
+            if meta.kind == RequestType.ALLTOALL:
+                cap = self.size
+                sp = (ctypes.c_int64 * cap)()
+                n = self._lib.hvd_result_splits(handle, sp, cap)
+                return out, [int(sp[i]) for i in range(max(n, 0))]
+            return out
+        finally:
+            self._lib.hvd_release(handle)
+
+    # -- blocking ops ------------------------------------------------------
+
+    def barrier(self):
+        rc = self._lib.hvd_barrier()
+        if rc != 0:
+            raise RuntimeError(self._lib.hvd_last_error().decode())
+
+    def join(self) -> int:
+        return self._lib.hvd_join()
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._lib.hvd_shutdown()
+        self.timeline.shutdown()

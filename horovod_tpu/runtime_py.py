@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import os
 import socket
-import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -296,66 +295,10 @@ class PyEngine(_EngineBase):
     # ------------------------------------------------------------------
 
     def _bootstrap(self, rdv_addr: str, rdv_port: int) -> None:
-        from horovod_tpu.runner.http_client import KVClient
+        from horovod_tpu.bootstrap import bootstrap_mesh
 
-        # Launcher-provided startup budget (hvdrun --start-timeout);
-        # parity: HOROVOD_GLOO_TIMEOUT_SECONDS (gloo_context.cc:38-40).
-        start_timeout = env_util.get_float("HVD_START_TIMEOUT", 120.0)
-        kv = KVClient(rdv_addr, rdv_port)
-        listener = su.listen_on()
-        port = listener.getsockname()[1]
-        # Learn the address peers can reach us at from the route the
-        # rendezvous connection takes (works multi-host without NIC config).
-        my_host = kv.local_address() or "127.0.0.1"
-        kv.put(f"hvd/addr/{self.rank}", f"{my_host}:{port}")
-        peers = {}
-        for i in range(self.size):
-            if i == self.rank:
-                continue
-            v = kv.wait_get(f"hvd/addr/{i}", timeout=start_timeout)
-            host, p = v.rsplit(":", 1)
-            peers[i] = (host, int(p))
-
-        # Full data mesh + a ctrl connection worker->rank0.  A rank
-        # connects to every lower rank; accepts from every higher one.
-        self._data: Dict[int, socket.socket] = {}
-        self._ctrl_sock: Optional[socket.socket] = None
-        self._ctrl_socks: Dict[int, socket.socket] = {}  # rank0 only
-
-        n_accept = self.size - 1 - self.rank
-        if self.rank == 0:
-            n_accept += self.size - 1  # ctrl connections
-        accept_results = {}
-
-        def _accept_loop():
-            for _ in range(n_accept):
-                s, _addr = listener.accept()
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                hdr = su.recv_exact(s, 8)
-                peer_rank, chan = struct.unpack("<ii", hdr)
-                accept_results[(peer_rank, chan)] = s
-
-        acceptor = threading.Thread(target=_accept_loop, daemon=True)
-        acceptor.start()
-
-        for j in range(self.rank):
-            s = su.connect_retry(*peers[j], timeout=start_timeout)
-            s.sendall(struct.pack("<ii", self.rank, 0))
-            self._data[j] = s
-        if self.rank != 0:
-            s = su.connect_retry(*peers[0], timeout=start_timeout)
-            s.sendall(struct.pack("<ii", self.rank, 1))
-            self._ctrl_sock = s
-
-        acceptor.join(timeout=start_timeout * 1.5)
-        if acceptor.is_alive():
-            raise ConnectionError("timed out waiting for peer connections")
-        for (peer_rank, chan), s in accept_results.items():
-            if chan == 0:
-                self._data[peer_rank] = s
-            else:
-                self._ctrl_socks[peer_rank] = s
-        listener.close()
+        self._data, self._ctrl_sock, self._ctrl_socks = bootstrap_mesh(
+            self.rank, self.size, rdv_addr, rdv_port)
 
         # ctrl receiver threads
         if self.rank == 0:
@@ -714,6 +657,9 @@ class PyEngine(_EngineBase):
         )
         if first.request_type == RequestType.ALLREDUCE:
             resp.tensor_sizes = [first.tensor_shape.num_elements]
+            resp.reduce_op = first.reduce_op
+            resp.prescale_factor = first.prescale_factor
+            resp.postscale_factor = first.postscale_factor
         elif first.request_type == RequestType.ALLGATHER:
             # First-dim size per rank, in rank order (0 for joined ranks).
             by_rank = {r.request_rank: r for r in reqs}
@@ -743,6 +689,9 @@ class PyEngine(_EngineBase):
             if pending is not None and \
                     pending.tensor_type == r.tensor_type and \
                     pending.devices == r.devices and \
+                    pending.reduce_op == r.reduce_op and \
+                    pending.prescale_factor == r.prescale_factor and \
+                    pending.postscale_factor == r.postscale_factor and \
                     pending_bytes + nbytes <= self.fusion_threshold:
                 pending.tensor_names.extend(r.tensor_names)
                 pending.tensor_sizes.extend(r.tensor_sizes)

@@ -66,6 +66,30 @@ def scenario_fusion():
         expect = np.full(64, sum(r + i for r in range(size)), np.float32)
         np.testing.assert_allclose(out, expect, rtol=1e-6)
 
+    # Mixed ops / scale factors submitted in one cycle: fusion must keep
+    # them apart (regression: fusing across reduce_op applied the first
+    # tensor's op to every fused tensor).
+    hs = {
+        "sum": hvd.allreduce_async(np.full(8, rank + 1.0, np.float32),
+                                   name="mix.sum", op=hvd.Sum),
+        "max": hvd.allreduce_async(np.full(8, rank + 1.0, np.float32),
+                                   name="mix.max", op=hvd.Max),
+        "scaled": hvd.allreduce_async(
+            np.ones(8, np.float32), name="mix.scaled", op=hvd.Sum,
+            prescale_factor=3.0),
+        "sum2": hvd.allreduce_async(np.full(8, 2.0, np.float32),
+                                    name="mix.sum2", op=hvd.Sum),
+    }
+    np.testing.assert_allclose(
+        hvd.synchronize(hs["sum"]),
+        np.full(8, sum(r + 1.0 for r in range(size)), np.float32))
+    np.testing.assert_allclose(hvd.synchronize(hs["max"]),
+                               np.full(8, float(size)))
+    np.testing.assert_allclose(hvd.synchronize(hs["scaled"]),
+                               np.full(8, 3.0 * size))
+    np.testing.assert_allclose(hvd.synchronize(hs["sum2"]),
+                               np.full(8, 2.0 * size))
+
 
 def scenario_allgather():
     rank, size = hvd.rank(), hvd.size()
@@ -172,6 +196,14 @@ SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
 def main():
     name = sys.argv[1]
     hvd.init()
+    expect_engine = os.environ.get("HVD_EXPECT_ENGINE")
+    if expect_engine:
+        from horovod_tpu import basics
+
+        got = type(basics._runtime).__name__
+        assert got == expect_engine, (
+            f"expected {expect_engine}, got {got} "
+            f"(fallback: {getattr(basics._runtime, 'native_fallback_reason', None)})")
     try:
         SCENARIOS[name]()
     finally:

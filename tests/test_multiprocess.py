@@ -20,7 +20,10 @@ WORKER = os.path.join(HERE, "eager_worker.py")
 
 
 def run_workers(scenario: str, np_: int = 2, timeout: float = 120.0,
-                extra_env=None):
+                extra_env=None, engine: str = "native"):
+    """engine: 'native' (C++ core), 'py' (Python engine), or 'mixed'
+    (alternating per rank) — mixed works because the two engines speak the
+    same wire protocol and run identical ring algorithms."""
     server = RendezvousServer("127.0.0.1")
     port = server.start()
     procs = []
@@ -36,6 +39,12 @@ def run_workers(scenario: str, np_: int = 2, timeout: float = 120.0,
                 "HVD_RENDEZVOUS_PORT": str(port),
                 "JAX_PLATFORMS": "cpu",
             })
+            if engine == "py" or (engine == "mixed" and rank % 2 == 1):
+                env["HVD_TPU_CORE"] = "py"
+                env["HVD_EXPECT_ENGINE"] = "PyEngine"
+            else:
+                env.pop("HVD_TPU_CORE", None)
+                env["HVD_EXPECT_ENGINE"] = "NativeEngine"
             if extra_env:
                 env.update(extra_env)
             procs.append(subprocess.Popen(
@@ -65,47 +74,62 @@ def run_workers(scenario: str, np_: int = 2, timeout: float = 120.0,
         server.stop()
 
 
+ENGINES = ["native", "py"]
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 @pytest.mark.parametrize("np_", [2, 4])
-def test_allreduce(np_):
-    run_workers("allreduce", np_)
+def test_allreduce(np_, engine):
+    run_workers("allreduce", np_, engine=engine)
 
 
-def test_fusion():
-    run_workers("fusion", 2)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_fusion(engine):
+    run_workers("fusion", 2, engine=engine)
 
 
+@pytest.mark.parametrize("engine", ENGINES)
 @pytest.mark.parametrize("np_", [2, 3])
-def test_allgather(np_):
-    run_workers("allgather", np_)
+def test_allgather(np_, engine):
+    run_workers("allgather", np_, engine=engine)
 
 
-def test_broadcast():
-    run_workers("broadcast", 3)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_broadcast(engine):
+    run_workers("broadcast", 3, engine=engine)
 
 
-def test_alltoall():
-    run_workers("alltoall", 3)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_alltoall(engine):
+    run_workers("alltoall", 3, engine=engine)
 
 
-def test_adasum():
-    run_workers("adasum", 4)
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_adasum(engine):
+    run_workers("adasum", 4, engine=engine)
 
 
-def test_join():
-    run_workers("join", 3)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_join(engine):
+    run_workers("join", 3, engine=engine)
 
 
-def test_barrier():
-    run_workers("barrier", 2)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_barrier(engine):
+    run_workers("barrier", 2, engine=engine)
 
 
-def test_error_mismatch():
-    run_workers("error_mismatch", 2)
+@pytest.mark.parametrize("engine", ENGINES)
+def test_error_mismatch(engine):
+    run_workers("error_mismatch", 2, engine=engine)
 
 
 def test_timeline(tmp_path):
+    # The timeline writer lives in the Python engine (the native core
+    # does not emit traces yet).
     path = str(tmp_path / "timeline.json")
-    run_workers("timeline", 2, extra_env={"HVD_TIMELINE": path})
+    run_workers("timeline", 2, extra_env={"HVD_TIMELINE": path},
+                engine="py")
     # Parity: test/test_timeline.py:31-57 — the trace must contain the
     # negotiation and op phases.
     with open(path) as f:
