@@ -37,7 +37,8 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
                int cross_rank, int cross_size, const int32_t* data_fds,
                const int32_t* ctrl_fds, double cycle_time_s,
                int64_t fusion_threshold, double stall_warn_s,
-               double stall_shutdown_s, int stall_check_disable) {
+               double stall_shutdown_s, int stall_check_disable,
+               int64_t cache_capacity) {
   if (g_engine) {
     g_last_error = "engine already initialized";
     return -1;
@@ -54,6 +55,7 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
   cfg.stall_warn_s = stall_warn_s;
   cfg.stall_shutdown_s = stall_shutdown_s;
   cfg.stall_check_disable = stall_check_disable != 0;
+  cfg.cache_capacity = cache_capacity;
   std::vector<int> data(data_fds, data_fds + size);
   std::vector<int> ctrl(ctrl_fds, ctrl_fds + size);
   try {
@@ -201,6 +203,15 @@ int hvd_join() {
     return -1;
   }
   return g_engine->Join();
+}
+
+// out: hits, misses, evictions, size, capacity.
+void hvd_cache_stats(int64_t* out) {
+  if (!g_engine) {
+    for (int i = 0; i < 5; ++i) out[i] = 0;
+    return;
+  }
+  g_engine->CacheStats(out);
 }
 
 }  // extern "C"

@@ -115,6 +115,142 @@ void HandleManager::Release(int64_t h) {
 }
 
 // ---------------------------------------------------------------------------
+// ResponseCache
+// ---------------------------------------------------------------------------
+
+bool ResponseCache::SameParams(const Request& a, const Request& b) {
+  return a.tensor_type == b.tensor_type &&
+         a.tensor_shape.dims == b.tensor_shape.dims &&
+         a.reduce_op == b.reduce_op &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor && a.device == b.device;
+}
+
+ResponseCache::State ResponseCache::Classify(const Request& req,
+                                             uint32_t* position) {
+  *position = 0;
+  if (!enabled() || req.request_type != RequestType::ALLREDUCE) return MISS;
+  auto it = by_name_.find(req.tensor_name);
+  if (it == by_name_.end()) {
+    ++misses;
+    return MISS;
+  }
+  if (!SameParams(it->second.params, req)) {
+    *position = it->second.position;
+    return INVALID;
+  }
+  ++hits;
+  *position = it->second.position;
+  return HIT;
+}
+
+const Response* ResponseCache::GetByPosition(uint32_t pos) const {
+  auto it = by_pos_.find(pos);
+  return it == by_pos_.end() ? nullptr : &it->second->response;
+}
+
+const std::string* ResponseCache::NameAt(uint32_t pos) const {
+  auto it = by_pos_.find(pos);
+  return it == by_pos_.end() ? nullptr : &it->second->name;
+}
+
+bool ResponseCache::SynthesizeRequest(uint32_t pos, int rank,
+                                      Request* out) const {
+  auto it = by_pos_.find(pos);
+  if (it == by_pos_.end()) return false;
+  *out = it->second->params;
+  out->request_rank = rank;
+  return true;
+}
+
+void ResponseCache::Touch(uint32_t pos) {
+  auto it = by_pos_.find(pos);
+  if (it == by_pos_.end()) return;
+  lru_.splice(lru_.end(), lru_, it->second->lru_it);
+}
+
+int64_t ResponseCache::PositionOf(const std::string& name) const {
+  auto it = by_name_.find(name);
+  return it == by_name_.end() ? -1 : static_cast<int64_t>(it->second.position);
+}
+
+void ResponseCache::Put(const Response& resp) {
+  if (!enabled() || resp.response_type != ResponseType::ALLREDUCE ||
+      !resp.error_message.empty())
+    return;
+  bool have_shapes = resp.tensor_shapes.size() == resp.tensor_names.size();
+  for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+    const auto& name = resp.tensor_names[i];
+    TensorShape shape;
+    if (have_shapes)
+      shape = resp.tensor_shapes[i];
+    else
+      shape.dims = {resp.tensor_sizes[i]};
+
+    Response single;
+    single.response_type = ResponseType::ALLREDUCE;
+    single.tensor_type = resp.tensor_type;
+    single.tensor_names = {name};
+    single.devices = resp.devices;
+    single.tensor_sizes = {resp.tensor_sizes[i]};
+    single.reduce_op = resp.reduce_op;
+    single.prescale_factor = resp.prescale_factor;
+    single.postscale_factor = resp.postscale_factor;
+    single.tensor_shapes = {shape};
+
+    Request params;
+    params.request_type = RequestType::ALLREDUCE;
+    params.tensor_type = resp.tensor_type;
+    params.tensor_name = name;
+    params.device = resp.devices.empty() ? "cpu" : resp.devices[0];
+    params.reduce_op = resp.reduce_op;
+    params.prescale_factor = resp.prescale_factor;
+    params.postscale_factor = resp.postscale_factor;
+    params.tensor_shape = std::move(shape);
+    PutOne(name, std::move(single), std::move(params));
+  }
+}
+
+void ResponseCache::PutOne(const std::string& name, Response resp,
+                           Request params) {
+  auto it = by_name_.find(name);
+  if (it != by_name_.end()) {
+    // In-place update keeps the position stable (shape changes re-cache
+    // under the same position).
+    it->second.response = std::move(resp);
+    it->second.params = std::move(params);
+    lru_.splice(lru_.end(), lru_, it->second.lru_it);
+    return;
+  }
+  if (static_cast<int64_t>(by_name_.size()) >= capacity_) {
+    const std::string victim = lru_.front();
+    lru_.pop_front();
+    auto vit = by_name_.find(victim);
+    if (vit != by_name_.end()) {
+      free_positions_.push_back(vit->second.position);
+      by_pos_.erase(vit->second.position);
+      by_name_.erase(vit);
+    }
+    ++evictions;
+  }
+  uint32_t pos;
+  if (!free_positions_.empty()) {
+    pos = free_positions_.front();
+    free_positions_.erase(free_positions_.begin());
+  } else {
+    pos = next_position_++;
+  }
+  Entry e;
+  e.name = name;
+  e.position = pos;
+  e.response = std::move(resp);
+  e.params = std::move(params);
+  e.lru_it = lru_.insert(lru_.end(), name);
+  auto [nit, _] = by_name_.emplace(name, std::move(e));
+  by_pos_[pos] = &nit->second;
+}
+
+// ---------------------------------------------------------------------------
 // Engine lifecycle
 // ---------------------------------------------------------------------------
 
@@ -126,6 +262,7 @@ Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
   for (int fd : ctrl_fds_)
     if (fd >= 0) SetNoDelay(fd);
   last_stall_check_s_ = NowS();
+  cache_.SetCapacity(cfg.cache_capacity);
   bg_ = std::thread([this] { BackgroundLoop(); });
 }
 
@@ -284,6 +421,15 @@ int Engine::Barrier(std::string* err) {
   return st == StatusType::OK ? 0 : -1;
 }
 
+void Engine::CacheStats(int64_t out[5]) {
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  out[0] = cache_.hits;
+  out[1] = cache_.misses;
+  out[2] = cache_.evictions;
+  out[3] = cache_.size();
+  out[4] = cache_.capacity();
+}
+
 int Engine::Join() {
   int64_t h = handles_.Allocate();
   {
@@ -354,10 +500,66 @@ bool Engine::RunLoopOnce() {
   return WorkerCycle(std::move(msgs));
 }
 
+void Engine::ClassifyRequests(std::vector<Request> msgs,
+                              std::vector<Request>* requests,
+                              std::vector<CacheHit>* hit_events) {
+  // Parity: the cache check at the top of ComputeResponseList
+  // (controller.cc:171-200), adapted to explicit hit events.
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  for (auto& req : msgs) {
+    auto rit = resend_uncached_.find(req.tensor_name);
+    if (rit != resend_uncached_.end()) {
+      resend_uncached_.erase(rit);
+      requests->push_back(std::move(req));
+      continue;
+    }
+    uint32_t pos = 0;
+    if (cache_.Classify(req, &pos) == ResponseCache::HIT)
+      hit_events->push_back({req.tensor_name, pos});
+    else
+      requests->push_back(std::move(req));
+  }
+}
+
+void Engine::ExecuteCachedHits(const std::vector<uint32_t>& hit_positions) {
+  if (hit_positions.empty()) return;
+  std::vector<Response> cached;
+  {
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    for (auto p : hit_positions) {
+      const Response* resp = cache_.GetByPosition(p);
+      if (resp == nullptr) {
+        std::fprintf(stderr, "[hvd-core %d] cache position %u missing\n",
+                     cfg_.rank, p);
+        continue;
+      }
+      cache_.Touch(p);
+      cached.push_back(*resp);  // copy: FuseResponses mutates its inputs
+    }
+  }
+  for (auto& resp : FuseResponses(std::move(cached)))
+    PerformResponse(resp, /*from_cache=*/true);
+}
+
+void Engine::ProcessResends(const std::vector<std::string>& resend_names) {
+  std::lock_guard<std::mutex> lk(queue_mu_);
+  std::lock_guard<std::mutex> clk(cache_mu_);
+  for (auto& nm : resend_names) {
+    auto it = table_.find(nm);
+    if (it != table_.end()) {
+      resend_uncached_.insert(nm);
+      request_queue_.push_back(it->second.request);
+    }
+  }
+}
+
 bool Engine::WorkerCycle(std::vector<Request> msgs) {
   int ctrl = ctrl_fds_[0];
-  if (!msgs.empty()) {
-    auto payload = EncodeRequestList(msgs, /*shutdown=*/false);
+  std::vector<Request> requests;
+  std::vector<CacheHit> hit_events;
+  ClassifyRequests(std::move(msgs), &requests, &hit_events);
+  if (!requests.empty() || !hit_events.empty()) {
+    auto payload = EncodeRequestList(requests, /*shutdown=*/false, hit_events);
     SendFrame(ctrl, kTagRequestList, payload.data(), payload.size());
   }
   while (Readable(ctrl, 0)) {
@@ -367,10 +569,14 @@ bool Engine::WorkerCycle(std::vector<Request> msgs) {
       throw SocketError("worker expected response list, got tag " +
                         std::to_string(tag));
     std::vector<Response> responses;
+    std::vector<uint32_t> hit_positions;
+    std::vector<std::string> resend;
     bool shutdown = false;
     if (!DecodeResponseList(payload.data(), payload.size(), &responses,
-                            &shutdown))
+                            &shutdown, &hit_positions, &resend))
       throw SocketError("malformed response list");
+    ProcessResends(resend);
+    ExecuteCachedHits(hit_positions);
     for (auto& resp : responses) PerformResponse(resp);
     if (shutdown) {
       shutdown_.store(true);
@@ -406,8 +612,29 @@ void Engine::AbsorbRequest(const Request& req,
 bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
   std::vector<std::string> ready;
   bool shutdown = false;
+  std::map<int, std::vector<std::string>> resend_by_rank;
 
-  for (auto& req : msgs) AbsorbRequest(req, &ready);
+  auto absorb_hit = [&](const std::string& name, uint32_t pos, int rank) {
+    // A hit event stands for the full Request; rebuild it from our own
+    // (coherent) cache and let it ride the ordinary message table.  If
+    // our entry was evicted in flight, ask the sender to resend.
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    const std::string* ent_name = cache_.NameAt(pos);
+    Request req;
+    if (ent_name == nullptr || *ent_name != name ||
+        !cache_.SynthesizeRequest(pos, rank, &req)) {
+      resend_by_rank[rank].push_back(name);
+      return;
+    }
+    hit_ranks_[name].insert(rank);
+    AbsorbRequest(req, &ready);
+  };
+
+  std::vector<Request> requests;
+  std::vector<CacheHit> own_hits;
+  ClassifyRequests(std::move(msgs), &requests, &own_hits);
+  for (auto& req : requests) AbsorbRequest(req, &ready);
+  for (auto& h : own_hits) absorb_hit(h.name, h.position, 0);
   for (int r = 1; r < cfg_.size; ++r) {
     int fd = ctrl_fds_[r];
     while (Readable(fd, 0)) {
@@ -417,22 +644,49 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
         throw SocketError("coordinator expected request list, got tag " +
                           std::to_string(tag));
       std::vector<Request> reqs;
+      std::vector<CacheHit> peer_hits;
       bool peer_shutdown = false;
       if (!DecodeRequestList(payload.data(), payload.size(), &reqs,
-                             &peer_shutdown))
+                             &peer_shutdown, &peer_hits))
         throw SocketError("malformed request list");
       shutdown = shutdown || peer_shutdown;
       for (auto& req : reqs) AbsorbRequest(req, &ready);
+      for (auto& h : peer_hits) absorb_hit(h.name, h.position, r);
     }
   }
 
   std::vector<Response> responses;
+  std::vector<uint32_t> hit_positions;
   for (auto& name : ready) {
     auto it = msg_table_.find(name);
     if (it == msg_table_.end()) continue;
     auto reqs = std::move(it->second.requests);
     msg_table_.erase(it);
-    responses.push_back(ConstructResponse(name, reqs));
+    std::set<int> hit_ranks;
+    auto hit = hit_ranks_.find(name);
+    if (hit != hit_ranks_.end()) {
+      hit_ranks = std::move(hit->second);
+      hit_ranks_.erase(hit);
+    }
+    bool all_hit = true;
+    for (auto& r : reqs)
+      if (!hit_ranks.count(r.request_rank)) {
+        all_hit = false;
+        break;
+      }
+    int64_t pos = -1;
+    if (all_hit) {
+      std::lock_guard<std::mutex> lk(cache_mu_);
+      pos = cache_.PositionOf(name);
+    }
+    if (pos >= 0) {
+      // Every contributor hit → all requests were synthesized from the
+      // same cache entry → the negotiated response IS the cached one;
+      // broadcast just the position.
+      hit_positions.push_back(static_cast<uint32_t>(pos));
+    } else {
+      responses.push_back(ConstructResponse(name, reqs));
+    }
   }
 
   if (static_cast<int>(joined_ranks_.size()) == cfg_.size) {
@@ -445,12 +699,25 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
 
   if (!cfg_.stall_check_disable) shutdown = CheckStalls() || shutdown;
 
-  if (!responses.empty() || shutdown) {
+  if (!responses.empty() || !hit_positions.empty() || !resend_by_rank.empty() ||
+      shutdown) {
     auto fused = FuseResponses(std::move(responses));
-    auto payload = EncodeResponseList(fused, shutdown);
-    for (int r = 1; r < cfg_.size; ++r)
-      SendFrame(ctrl_fds_[r], kTagResponseList, payload.data(),
-                payload.size());
+    std::vector<uint8_t> shared;
+    for (int r = 1; r < cfg_.size; ++r) {
+      auto rit = resend_by_rank.find(r);
+      if (rit != resend_by_rank.end()) {
+        auto payload =
+            EncodeResponseList(fused, shutdown, hit_positions, rit->second);
+        SendFrame(ctrl_fds_[r], kTagResponseList, payload.data(),
+                  payload.size());
+      } else {
+        if (shared.empty())
+          shared = EncodeResponseList(fused, shutdown, hit_positions);
+        SendFrame(ctrl_fds_[r], kTagResponseList, shared.data(),
+                  shared.size());
+      }
+    }
+    ExecuteCachedHits(hit_positions);
     for (auto& resp : fused) PerformResponse(resp);
     if (shutdown) {
       shutdown_.store(true);
@@ -568,6 +835,9 @@ Response Engine::ConstructResponse(const std::string& name,
     resp.reduce_op = first.reduce_op;
     resp.prescale_factor = first.prescale_factor;
     resp.postscale_factor = first.postscale_factor;
+    // Negotiated dims ride the response so cache parameters stay
+    // coherent on every rank (incl. joined ranks' stand-ins).
+    resp.tensor_shapes = {first.tensor_shape};
   } else if (first.request_type == RequestType::ALLGATHER) {
     // First-dim size per rank, rank order (0 for joined ranks).
     std::map<int, const Request*> by_rank;
@@ -613,6 +883,9 @@ std::vector<Response> Engine::FuseResponses(std::vector<Response> responses) {
       pending.tensor_sizes.insert(pending.tensor_sizes.end(),
                                   r.tensor_sizes.begin(),
                                   r.tensor_sizes.end());
+      pending.tensor_shapes.insert(pending.tensor_shapes.end(),
+                                   r.tensor_shapes.begin(),
+                                   r.tensor_shapes.end());
       pending_bytes += nbytes;
     } else {
       if (have_pending) out.push_back(std::move(pending));
@@ -662,7 +935,7 @@ std::vector<TensorTableEntry> Engine::GetEntries(const Response& resp) {
   return entries;
 }
 
-void Engine::PerformResponse(const Response& resp) {
+void Engine::PerformResponse(const Response& resp, bool from_cache) {
   if (resp.response_type == ResponseType::JOIN) {
     if (!resp.tensor_sizes.empty())
       last_joined_rank_.store(static_cast<int>(resp.tensor_sizes[0]));
@@ -698,6 +971,15 @@ void Engine::PerformResponse(const Response& resp) {
       }
     }
     return;
+  }
+
+  if (!from_cache && resp.response_type == ResponseType::ALLREDUCE) {
+    // Populate the response cache BEFORE execution and regardless of
+    // execution outcome: the put stores metadata only, and doing it
+    // unconditionally in response-stream order is what keeps every
+    // rank's cache (positions, LRU, evictions) coherent.
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    cache_.Put(resp);
   }
 
   auto entries = GetEntries(resp);

@@ -25,6 +25,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <list>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -90,6 +91,60 @@ struct EngineConfig {
   double stall_warn_s = 60.0;
   double stall_shutdown_s = 0.0;
   bool stall_check_disable = false;
+  int64_t cache_capacity = 1024;  // 0 disables the response cache
+};
+
+// LRU cache of previously negotiated single-tensor ALLREDUCE responses,
+// position-addressed and kept coherent across ranks by mutating it only at
+// response-execution time.  Parity: horovod/common/response_cache.cc/.h,
+// protocol adapted to the star controller (see
+// horovod_tpu/common/response_cache.py — the Python twin is the spec).
+class ResponseCache {
+ public:
+  enum State { MISS = 0, HIT = 1, INVALID = 2 };
+
+  explicit ResponseCache(int64_t capacity) : capacity_(capacity) {}
+  // Only valid before first use (the engine ctor, pre-background-thread).
+  void SetCapacity(int64_t c) { capacity_ = c; }
+  bool enabled() const { return capacity_ > 0; }
+
+  State Classify(const Request& req, uint32_t* position);
+  // nullptr when the position is vacant.
+  const Response* GetByPosition(uint32_t pos) const;
+  const std::string* NameAt(uint32_t pos) const;
+  // Rebuilds the full Request a hit event stands for; false if vacant.
+  bool SynthesizeRequest(uint32_t pos, int rank, Request* out) const;
+  void Touch(uint32_t pos);
+  // Caches each tensor of an executed ALLREDUCE response as its own
+  // single-tensor response.  Exact dims come from the negotiated
+  // resp.tensor_shapes — response-carried, hence identical on every
+  // rank regardless of local request state (joined ranks included).
+  void Put(const Response& resp);
+  // Position of `name`, or -1.
+  int64_t PositionOf(const std::string& name) const;
+
+  int64_t hits = 0, misses = 0, evictions = 0;
+  int64_t size() const { return static_cast<int64_t>(by_name_.size()); }
+  int64_t capacity() const { return capacity_; }
+
+ private:
+  struct Entry {
+    std::string name;
+    uint32_t position = 0;
+    Response response;  // single-tensor
+    Request params;     // canonical request (rank field unused)
+    std::list<std::string>::iterator lru_it;
+  };
+  void PutOne(const std::string& name, Response resp, Request params);
+  static bool SameParams(const Request& a, const Request& b);
+
+  int64_t capacity_;
+  std::unordered_map<std::string, Entry> by_name_;
+  std::unordered_map<uint32_t, Entry*> by_pos_;
+  std::list<std::string> lru_;  // front = least recently used; O(1) via
+                                // the iterators stored in each Entry
+  std::vector<uint32_t> free_positions_;
+  uint32_t next_position_ = 0;
 };
 
 class Engine {
@@ -120,6 +175,9 @@ class Engine {
   int Barrier(std::string* err);  // blocking; 0 ok
   int Join();                     // blocking; returns last joined rank
 
+  // hits/misses/evictions/size/capacity, for introspection + tests.
+  void CacheStats(int64_t out[5]);
+
   HandleManager& handles() { return handles_; }
   const EngineConfig& config() const { return cfg_; }
   void Shutdown();
@@ -135,6 +193,12 @@ class Engine {
   bool WorkerCycle(std::vector<Request> msgs);
   bool CoordinatorCycle(std::vector<Request> msgs);
   void AbsorbRequest(const Request& req, std::vector<std::string>* ready);
+  // Splits popped requests into uncached requests + cache-hit events.
+  void ClassifyRequests(std::vector<Request> msgs,
+                        std::vector<Request>* requests,
+                        std::vector<CacheHit>* hit_events);
+  void ExecuteCachedHits(const std::vector<uint32_t>& hit_positions);
+  void ProcessResends(const std::vector<std::string>& resend_names);
   Response ConstructResponse(const std::string& name,
                              const std::vector<Request>& reqs);
   std::vector<Response> FuseResponses(std::vector<Response> responses);
@@ -144,7 +208,7 @@ class Engine {
 
   // Execution.
   std::vector<TensorTableEntry> GetEntries(const Response& resp);
-  void PerformResponse(const Response& resp);
+  void PerformResponse(const Response& resp, bool from_cache = false);
   void DoAllreduce(std::vector<TensorTableEntry>& entries,
                    const Response& resp);
   void DoAllgather(std::vector<TensorTableEntry>& entries,
@@ -181,6 +245,15 @@ class Engine {
   std::map<std::string, MessageTableEntry> msg_table_;
   std::set<int> joined_ranks_;
   double last_stall_check_s_ = 0;
+
+  // Response cache (both roles). All access is on the background thread,
+  // except CacheStats which takes cache_mu_.
+  std::mutex cache_mu_;
+  ResponseCache cache_{1024};
+  std::unordered_set<std::string> resend_uncached_;
+  // Coordinator only: ranks whose contribution for a name arrived as a
+  // hit event (→ response can be broadcast as a bare position).
+  std::unordered_map<std::string, std::set<int>> hit_ranks_;
 
   // Fusion scratch (parity: fusion_buffer_manager.cc — one lazily grown
   // persistent buffer reused across fused launches).

@@ -123,6 +123,11 @@ void EncodeResponse(const Response& r, std::vector<uint8_t>& b) {
   PutU8(b, static_cast<uint8_t>(r.reduce_op));
   PutF64(b, r.prescale_factor);
   PutF64(b, r.postscale_factor);
+  PutU32(b, static_cast<uint32_t>(r.tensor_shapes.size()));
+  for (auto& s : r.tensor_shapes) {
+    PutU8(b, static_cast<uint8_t>(s.dims.size()));
+    for (auto d : s.dims) PutI64(b, d);
+  }
 }
 
 Response DecodeResponse(Reader& rd) {
@@ -142,46 +147,81 @@ Response DecodeResponse(Reader& rd) {
   r.reduce_op = static_cast<ReduceOp>(rd.U8());
   r.prescale_factor = rd.F64();
   r.postscale_factor = rd.F64();
+  uint32_t n_shapes = rd.U32();
+  for (uint32_t i = 0; i < n_shapes && !rd.fail; ++i) {
+    TensorShape s;
+    uint8_t ndim = rd.U8();
+    for (uint8_t j = 0; j < ndim; ++j) s.dims.push_back(rd.I64());
+    r.tensor_shapes.push_back(std::move(s));
+  }
   return r;
 }
 
 }  // namespace
 
 std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
-                                       bool shutdown) {
+                                       bool shutdown,
+                                       const std::vector<CacheHit>& hits) {
   std::vector<uint8_t> b;
   PutU8(b, shutdown ? 1 : 0);
   PutU32(b, static_cast<uint32_t>(reqs.size()));
   for (auto& r : reqs) EncodeRequest(r, b);
+  PutU32(b, static_cast<uint32_t>(hits.size()));
+  for (auto& h : hits) {
+    PutStr(b, h.name);
+    PutU32(b, h.position);
+  }
   return b;
 }
 
 bool DecodeRequestList(const uint8_t* data, size_t len,
-                       std::vector<Request>* out, bool* shutdown) {
+                       std::vector<Request>* out, bool* shutdown,
+                       std::vector<CacheHit>* hits) {
   Reader rd{data, len};
   *shutdown = rd.U8() != 0;
   uint32_t n = rd.U32();
   for (uint32_t i = 0; i < n && !rd.fail; ++i)
     out->push_back(DecodeRequest(rd));
+  uint32_t n_hits = rd.U32();
+  for (uint32_t i = 0; i < n_hits && !rd.fail; ++i) {
+    CacheHit h;
+    h.name = rd.Str();
+    h.position = rd.U32();
+    hits->push_back(std::move(h));
+  }
   return !rd.fail;
 }
 
-std::vector<uint8_t> EncodeResponseList(const std::vector<Response>& resps,
-                                        bool shutdown) {
+std::vector<uint8_t> EncodeResponseList(
+    const std::vector<Response>& resps, bool shutdown,
+    const std::vector<uint32_t>& hit_positions,
+    const std::vector<std::string>& resend_names) {
   std::vector<uint8_t> b;
   PutU8(b, shutdown ? 1 : 0);
   PutU32(b, static_cast<uint32_t>(resps.size()));
   for (auto& r : resps) EncodeResponse(r, b);
+  PutU32(b, static_cast<uint32_t>(hit_positions.size()));
+  for (auto p : hit_positions) PutU32(b, p);
+  PutU32(b, static_cast<uint32_t>(resend_names.size()));
+  for (auto& nm : resend_names) PutStr(b, nm);
   return b;
 }
 
 bool DecodeResponseList(const uint8_t* data, size_t len,
-                        std::vector<Response>* out, bool* shutdown) {
+                        std::vector<Response>* out, bool* shutdown,
+                        std::vector<uint32_t>* hit_positions,
+                        std::vector<std::string>* resend_names) {
   Reader rd{data, len};
   *shutdown = rd.U8() != 0;
   uint32_t n = rd.U32();
   for (uint32_t i = 0; i < n && !rd.fail; ++i)
     out->push_back(DecodeResponse(rd));
+  uint32_t n_hits = rd.U32();
+  for (uint32_t i = 0; i < n_hits && !rd.fail; ++i)
+    hit_positions->push_back(rd.U32());
+  uint32_t n_resend = rd.U32();
+  for (uint32_t i = 0; i < n_resend && !rd.fail; ++i)
+    resend_names->push_back(rd.Str());
   return !rd.fail;
 }
 

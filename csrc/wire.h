@@ -43,17 +43,35 @@ struct Response {
   ReduceOp reduce_op = ReduceOp::SUM;
   double prescale_factor = 1.0;
   double postscale_factor = 1.0;
+  // For allreduce: the exact negotiated dims of each fused tensor, one
+  // per tensor_names entry — authoritative on every rank, which keeps
+  // response-cache parameters coherent (see engine.h ResponseCache).
+  std::vector<TensorShape> tensor_shapes;
+};
+
+// A response-cache hit event: this rank is ready to re-run the cached
+// response for `name` at cache position `position` (see
+// horovod_tpu/common/response_cache.py for the protocol).
+struct CacheHit {
+  std::string name;
+  uint32_t position = 0;
 };
 
 std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
-                                       bool shutdown);
+                                       bool shutdown,
+                                       const std::vector<CacheHit>& hits = {});
 // Returns false on malformed input.
 bool DecodeRequestList(const uint8_t* data, size_t len,
-                       std::vector<Request>* out, bool* shutdown);
+                       std::vector<Request>* out, bool* shutdown,
+                       std::vector<CacheHit>* hits);
 
-std::vector<uint8_t> EncodeResponseList(const std::vector<Response>& resps,
-                                        bool shutdown);
+std::vector<uint8_t> EncodeResponseList(
+    const std::vector<Response>& resps, bool shutdown,
+    const std::vector<uint32_t>& hit_positions = {},
+    const std::vector<std::string>& resend_names = {});
 bool DecodeResponseList(const uint8_t* data, size_t len,
-                        std::vector<Response>* out, bool* shutdown);
+                        std::vector<Response>* out, bool* shutdown,
+                        std::vector<uint32_t>* hit_positions,
+                        std::vector<std::string>* resend_names);
 
 }  // namespace hvd

@@ -13,6 +13,7 @@ parallelism) and ``horovod_tpu.ops`` (XLA + Pallas data plane).
 from horovod_tpu.version import __version__  # noqa: F401
 
 from horovod_tpu.basics import (  # noqa: F401
+    cache_stats,
     cross_rank,
     cross_size,
     cuda_built,

@@ -199,3 +199,11 @@ def mpi_enabled() -> bool:
 
 def mpi_threads_supported() -> bool:
     return False
+
+
+def cache_stats() -> dict:
+    """Response-cache counters (hits/misses/evictions/size/capacity).
+    Parity: the reference exposes no such API, but its autotuner and
+    timeline read equivalent internals; this is the observable surface
+    for tests and tuning."""
+    return _engine().cache_stats()

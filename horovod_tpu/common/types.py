@@ -238,6 +238,11 @@ class Response:
     reduce_op: ReduceOp = ReduceOp.SUM
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # For allreduce: the exact negotiated dims of each fused tensor, one
+    # per tensor_names entry.  Authoritative on every rank (including
+    # joined ranks executing zero stand-ins), which keeps response-cache
+    # parameters coherent without relying on rank-local request state.
+    tensor_shapes: List["TensorShape"] = field(default_factory=list)
 
     def add_tensor_name(self, name: str) -> None:
         self.tensor_names.append(name)

@@ -14,13 +14,24 @@ Layout (all integers little-endian):
               varstr tensor_name, i32 root_rank, varstr device,
               u8 reduce_op, f64 prescale, f64 postscale,
               u8 ndim, i64 dims[ndim]
-  RequestList  := u8 shutdown, u32 n, Request[n]
+  CacheHit := varstr name, u32 position
+  RequestList  := u8 shutdown, u32 n, Request[n],
+                  u32 n_hits, CacheHit[n_hits]
   Response := u8 response_type, u8 tensor_type, u32 n_names,
               varstr[n_names], varstr error_message,
               u32 n_devices, varstr[n_devices],
               u32 n_sizes, i64 sizes[n_sizes],
-              u8 reduce_op, f64 prescale, f64 postscale
-  ResponseList := u8 shutdown, u32 n, Response[n]
+              u8 reduce_op, f64 prescale, f64 postscale,
+              u32 n_shapes, { u8 ndim, i64 dims[ndim] }[n_shapes]
+  ResponseList := u8 shutdown, u32 n, Response[n],
+                  u32 n_hit_positions, u32 pos[n_hit_positions],
+                  u32 n_resend, varstr resend_names[n_resend]
+
+The cache fields carry the response-cache fast path (parity:
+``horovod/common/response_cache.h:45-167`` — there a fixed-width
+bitvector allreduced across ranks; here explicit hit events up to the
+coordinator and hit positions back down, see
+``horovod_tpu/common/response_cache.py``).
 """
 
 from __future__ import annotations
@@ -96,22 +107,36 @@ def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
     ), off
 
 
-def encode_request_list(reqs: List[Request], shutdown: bool = False) -> bytes:
+def encode_request_list(reqs: List[Request], shutdown: bool = False,
+                        cache_hits: List[Tuple[str, int]] = ()) -> bytes:
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(reqs))
     for r in reqs:
         encode_request(r, buf)
+    buf += struct.pack("<I", len(cache_hits))
+    for name, pos in cache_hits:
+        _pack_str(buf, name)
+        buf += struct.pack("<I", pos)
     return bytes(buf)
 
 
-def decode_request_list(data: bytes) -> Tuple[List[Request], bool]:
+def decode_request_list(
+        data: bytes) -> Tuple[List[Request], bool, List[Tuple[str, int]]]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
     for _ in range(n):
         r, off = decode_request(data, off)
         out.append(r)
-    return out, bool(shutdown)
+    (n_hits,) = struct.unpack_from("<I", data, off)
+    off += 4
+    hits = []
+    for _ in range(n_hits):
+        name, off = _unpack_str(data, off)
+        (pos,) = struct.unpack_from("<I", data, off)
+        off += 4
+        hits.append((name, pos))
+    return out, bool(shutdown), hits
 
 
 def encode_response(resp: Response, buf: bytearray) -> None:
@@ -128,6 +153,12 @@ def encode_response(resp: Response, buf: bytearray) -> None:
         buf += struct.pack("<q", s)
     buf += struct.pack("<Bdd", int(resp.reduce_op), resp.prescale_factor,
                        resp.postscale_factor)
+    buf += struct.pack("<I", len(resp.tensor_shapes))
+    for shape in resp.tensor_shapes:
+        dims = shape.dims
+        buf += struct.pack("<B", len(dims))
+        for d in dims:
+            buf += struct.pack("<q", d)
 
 
 def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
@@ -153,6 +184,18 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
         sizes.append(s)
     rop, pre, post = struct.unpack_from("<Bdd", data, off)
     off += struct.calcsize("<Bdd")
+    (n_shapes,) = struct.unpack_from("<I", data, off)
+    off += 4
+    shapes = []
+    for _ in range(n_shapes):
+        (ndim,) = struct.unpack_from("<B", data, off)
+        off += 1
+        dims = []
+        for _ in range(ndim):
+            (d,) = struct.unpack_from("<q", data, off)
+            off += 8
+            dims.append(d)
+        shapes.append(TensorShape(dims))
     return Response(
         response_type=ResponseType(rtype),
         tensor_type=DataType(ttype),
@@ -163,23 +206,45 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
         reduce_op=ReduceOp(rop),
         prescale_factor=pre,
         postscale_factor=post,
+        tensor_shapes=shapes,
     ), off
 
 
-def encode_response_list(resps: List[Response],
-                         shutdown: bool = False) -> bytes:
+def encode_response_list(resps: List[Response], shutdown: bool = False,
+                         hit_positions: List[int] = (),
+                         resend_names: List[str] = ()) -> bytes:
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(resps))
     for r in resps:
         encode_response(r, buf)
+    buf += struct.pack("<I", len(hit_positions))
+    for p in hit_positions:
+        buf += struct.pack("<I", p)
+    buf += struct.pack("<I", len(resend_names))
+    for nm in resend_names:
+        _pack_str(buf, nm)
     return bytes(buf)
 
 
-def decode_response_list(data: bytes) -> Tuple[List[Response], bool]:
+def decode_response_list(
+        data: bytes) -> Tuple[List[Response], bool, List[int], List[str]]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
     for _ in range(n):
         r, off = decode_response(data, off)
         out.append(r)
-    return out, bool(shutdown)
+    (n_hits,) = struct.unpack_from("<I", data, off)
+    off += 4
+    hits = []
+    for _ in range(n_hits):
+        (p,) = struct.unpack_from("<I", data, off)
+        off += 4
+        hits.append(p)
+    (n_resend,) = struct.unpack_from("<I", data, off)
+    off += 4
+    resend = []
+    for _ in range(n_resend):
+        nm, off = _unpack_str(data, off)
+        resend.append(nm)
+    return out, bool(shutdown), hits, resend

@@ -75,9 +75,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvd_create.argtypes = [
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
         c.POINTER(c.c_int32), c.POINTER(c.c_int32),
-        c.c_double, c.c_int64, c.c_double, c.c_double, c.c_int,
+        c.c_double, c.c_int64, c.c_double, c.c_double, c.c_int, c.c_int64,
     ]
     lib.hvd_create.restype = c.c_int
+    lib.hvd_cache_stats.argtypes = [c.POINTER(c.c_int64)]
+    lib.hvd_cache_stats.restype = None
     lib.hvd_shutdown.argtypes = []
     lib.hvd_shutdown.restype = None
     lib.hvd_is_aborted.restype = c.c_int

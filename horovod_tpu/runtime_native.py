@@ -86,7 +86,8 @@ class NativeEngine:
             env_util.get_float(env_util.STALL_CHECK_TIME, 60.0),
             env_util.get_float(env_util.STALL_SHUTDOWN_TIME, 0.0),
             1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
-            else 0)
+            else 0,
+            env_util.get_int(env_util.CACHE_CAPACITY, 1024))
         if rc != 0:
             raise OSError(self._lib.hvd_last_error().decode())
 
@@ -224,6 +225,12 @@ class NativeEngine:
         rc = self._lib.hvd_barrier()
         if rc != 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
+
+    def cache_stats(self):
+        out = (ctypes.c_int64 * 5)()
+        self._lib.hvd_cache_stats(out)
+        return {"hits": out[0], "misses": out[1], "evictions": out[2],
+                "size": out[3], "capacity": out[4]}
 
     def join(self) -> int:
         return self._lib.hvd_join()

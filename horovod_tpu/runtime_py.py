@@ -40,6 +40,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from horovod_tpu.common import wire
+from horovod_tpu.common import response_cache as rcache
 from horovod_tpu.common.types import (
     DataType,
     ReduceOp,
@@ -194,6 +195,10 @@ class _EngineBase:
     def synchronize(self, handle: int, timeout: Optional[float] = None):
         return self.handles.wait(handle, timeout)
 
+    def cache_stats(self) -> Dict[str, int]:
+        return {"hits": 0, "misses": 0, "evictions": 0, "size": 0,
+                "capacity": 0}
+
 
 class SingleProcessEngine(_EngineBase):
     """size == 1: every collective is the identity (modulo scaling), applied
@@ -283,6 +288,14 @@ class PyEngine(_EngineBase):
         self._ctrl_inbox: "list" = []
         self._ctrl_lock = threading.Lock()
         self._last_stall_check = time.monotonic()
+
+        # response cache (parity: response_cache.cc; protocol adapted to
+        # the star controller — see common/response_cache.py docstring).
+        # All cache state is touched only on the background thread.
+        self._cache = rcache.ResponseCache(
+            env_util.get_int(env_util.CACHE_CAPACITY, 1024))
+        self._resend_uncached: set = set()
+        self._hit_ranks: Dict[str, set] = {}
 
         self._bootstrap(rdv_addr, rdv_port)
 
@@ -496,11 +509,68 @@ class PyEngine(_EngineBase):
             return self._coordinator_cycle(msgs)
         return self._worker_cycle(msgs)
 
+    # -- cache classification (both roles, background thread only) -------
+
+    def _classify(self, msgs: List[Request]):
+        """Split popped requests into (uncached requests, hit events).
+        Parity: the cache check at the top of ComputeResponseList
+        (controller.cc:171-200)."""
+        requests: List[Request] = []
+        hits: List[tuple] = []
+        for req in msgs:
+            if req.tensor_name in self._resend_uncached:
+                self._resend_uncached.discard(req.tensor_name)
+                requests.append(req)
+                continue
+            state, pos = self._cache.classify(req)
+            if state == rcache.HIT:
+                hits.append((req.tensor_name, pos))
+            else:
+                requests.append(req)
+        return requests, hits
+
+    def _execute_cached_hits(self, hit_positions: List[int]) -> None:
+        cached: List[Response] = []
+        for p in hit_positions:
+            resp = self._cache.get_by_position(p)
+            if resp is None:
+                # Coherence violation — should be impossible; surface it.
+                self.log.error("cache position %d missing locally", p)
+                continue
+            self._cache.touch(p)
+            # Copy: _fuse_responses mutates its inputs in place, and the
+            # cached Response must stay single-tensor.
+            cached.append(Response(
+                response_type=resp.response_type,
+                tensor_type=resp.tensor_type,
+                tensor_names=list(resp.tensor_names),
+                devices=list(resp.devices),
+                tensor_sizes=list(resp.tensor_sizes),
+                reduce_op=resp.reduce_op,
+                prescale_factor=resp.prescale_factor,
+                postscale_factor=resp.postscale_factor,
+                tensor_shapes=list(resp.tensor_shapes),
+            ))
+        for resp in self._fuse_responses(cached):
+            self._perform_operation(resp, from_cache=True)
+
+    def _process_resends(self, resend_names: List[str]) -> None:
+        """Coordinator could not resolve our hit event (entry evicted
+        there in flight): requeue the original full Request."""
+        with self._queue_lock:
+            for nm in resend_names:
+                ent = self._table.get(nm)
+                if ent is not None:
+                    self._resend_uncached.add(nm)
+                    self._request_queue.append(ent.request)
+
     # -- worker ---------------------------------------------------------
 
     def _worker_cycle(self, msgs: List[Request]) -> bool:
-        if msgs:
-            payload = wire.encode_request_list(msgs, shutdown=False)
+        requests, hit_events = self._classify(msgs)
+        if requests or hit_events:
+            payload = wire.encode_request_list(requests, shutdown=False,
+                                               cache_hits=hit_events)
             try:
                 su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
             except (ConnectionError, OSError):
@@ -510,7 +580,10 @@ class PyEngine(_EngineBase):
             inbox = self._response_inbox
             self._response_inbox = []
         for payload in inbox:
-            responses, shutdown = wire.decode_response_list(payload)
+            responses, shutdown, hit_positions, resend = \
+                wire.decode_response_list(payload)
+            self._process_resends(resend)
+            self._execute_cached_hits(hit_positions)
             for resp in responses:
                 self._perform_operation(resp)
             if shutdown:
@@ -523,6 +596,8 @@ class PyEngine(_EngineBase):
     def _coordinator_cycle(self, msgs: List[Request]) -> bool:
         ready: List[str] = []
         shutdown = False
+        # names this cycle asks specific ranks to resend in full
+        resend_by_rank: Dict[int, List[str]] = {}
 
         def _absorb(req: Request) -> None:
             nonlocal ready, shutdown
@@ -544,23 +619,53 @@ class PyEngine(_EngineBase):
             if self._msg_table.increment(req, len(self._joined_ranks)):
                 ready.append(req.tensor_name)
 
-        for req in msgs:
+        def _absorb_hit(name: str, pos: int, rank: int) -> None:
+            # A hit event stands for the full Request; rebuild it from
+            # our own cache (coherent with the sender's) and let it ride
+            # the ordinary message table.  If our entry was evicted in
+            # flight, ask the sender to resend the full request.
+            if self._cache.name_at(pos) != name:
+                resend_by_rank.setdefault(rank, []).append(name)
+                return
+            req = self._cache.synthesize_request(pos, rank)
+            self._hit_ranks.setdefault(name, set()).add(rank)
             _absorb(req)
+
+        requests, own_hits = self._classify(msgs)
+        for req in requests:
+            _absorb(req)
+        for name, pos in own_hits:
+            _absorb_hit(name, pos, 0)
         with self._ctrl_lock:
             inbox = self._ctrl_inbox
             self._ctrl_inbox = []
-        for _peer, payload in inbox:
-            reqs, peer_shutdown = wire.decode_request_list(payload)
+        for peer, payload in inbox:
+            reqs, peer_shutdown, peer_hits = \
+                wire.decode_request_list(payload)
             shutdown = shutdown or peer_shutdown
             for req in reqs:
                 _absorb(req)
+            for name, pos in peer_hits:
+                _absorb_hit(name, pos, peer)
 
         responses: List[Response] = []
+        hit_positions: List[int] = []
         for name in ready:
             reqs = self._msg_table.pop(name)
             if self.timeline.enabled:
                 self.timeline.negotiate_end(name)
-            responses.append(self._construct_response(name, reqs))
+            hit_ranks = self._hit_ranks.pop(name, set())
+            contributors = {r.request_rank for r in reqs}
+            ent_pos = -1
+            if hit_ranks >= contributors:
+                # Every contributor hit → all requests were synthesized
+                # from the same cache entry → the negotiated response IS
+                # the cached one; broadcast just the position.
+                ent_pos = self._cache.position_of(name)
+            if ent_pos >= 0:
+                hit_positions.append(ent_pos)
+            else:
+                responses.append(self._construct_response(name, reqs))
 
         if len(self._joined_ranks) == self.size:
             responses.append(Response(
@@ -571,14 +676,26 @@ class PyEngine(_EngineBase):
         if not self.stall_check_disable:
             shutdown = self._check_stalls() or shutdown
 
-        if responses or shutdown:
+        if responses or hit_positions or resend_by_rank or shutdown:
             fused = self._fuse_responses(responses)
-            payload = wire.encode_response_list(fused, shutdown=shutdown)
-            for s in self._ctrl_socks.values():
+            shared = None
+            for r, s in self._ctrl_socks.items():
+                resend = resend_by_rank.get(r, [])
+                if resend:
+                    payload = wire.encode_response_list(
+                        fused, shutdown=shutdown,
+                        hit_positions=hit_positions, resend_names=resend)
+                else:
+                    if shared is None:
+                        shared = wire.encode_response_list(
+                            fused, shutdown=shutdown,
+                            hit_positions=hit_positions)
+                    payload = shared
                 try:
                     su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
                 except (ConnectionError, OSError):
                     pass
+            self._execute_cached_hits(hit_positions)
             for resp in fused:
                 self._perform_operation(resp)
             if shutdown:
@@ -660,6 +777,9 @@ class PyEngine(_EngineBase):
             resp.reduce_op = first.reduce_op
             resp.prescale_factor = first.prescale_factor
             resp.postscale_factor = first.postscale_factor
+            # Negotiated dims ride the response so cache parameters stay
+            # coherent on every rank (incl. joined ranks' stand-ins).
+            resp.tensor_shapes = [first.tensor_shape]
         elif first.request_type == RequestType.ALLGATHER:
             # First-dim size per rank, in rank order (0 for joined ranks).
             by_rank = {r.request_rank: r for r in reqs}
@@ -695,6 +815,7 @@ class PyEngine(_EngineBase):
                     pending_bytes + nbytes <= self.fusion_threshold:
                 pending.tensor_names.extend(r.tensor_names)
                 pending.tensor_sizes.extend(r.tensor_sizes)
+                pending.tensor_shapes.extend(r.tensor_shapes)
                 pending_bytes += nbytes
             else:
                 if pending is not None:
@@ -733,7 +854,8 @@ class PyEngine(_EngineBase):
                         TensorTableEntry(nm, arr, -1, req))
         return entries
 
-    def _perform_operation(self, resp: Response) -> None:
+    def _perform_operation(self, resp: Response,
+                           from_cache: bool = False) -> None:
         from horovod_tpu.ops import cpu_backend
 
         if resp.response_type == ResponseType.JOIN:
@@ -759,6 +881,14 @@ class PyEngine(_EngineBase):
                             Status.precondition_error(resp.error_message),
                             None)
             return
+
+        if not from_cache:
+            # Populate the response cache BEFORE execution and regardless
+            # of local execution status: the put stores metadata only, and
+            # doing it unconditionally in response-stream order is what
+            # keeps every rank's cache (positions, LRU, evictions)
+            # coherent even if one rank's data plane hiccups.
+            self._cache.put(resp)
 
         entries = self._get_entries(resp)
         op_name = resp.response_type.name
@@ -787,6 +917,9 @@ class PyEngine(_EngineBase):
             self._release_name(e.name)
             if e.handle >= 0:
                 self.handles.mark_done(e.handle, status, res)
+
+    def cache_stats(self) -> Dict[str, int]:
+        return self._cache.stats()
 
     def _abort(self, reason: str) -> None:
         self._aborted = True

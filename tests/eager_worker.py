@@ -189,6 +189,70 @@ def scenario_timeline():
     hvd.barrier()
 
 
+def scenario_cache_steady_state():
+    # Same named tensors step after step: the first step negotiates and
+    # caches; later steps must ride the cache fast path (hit events +
+    # position broadcasts) and still be numerically correct.
+    rank, size = hvd.rank(), hvd.size()
+    steps = 6
+    n_tensors = 4
+    for step in range(steps):
+        handles = [hvd.allreduce_async(
+            np.full(32, rank + 1.0 + i + step, np.float32),
+            name=f"cache.t{i}", op=hvd.Sum) for i in range(n_tensors)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expect = np.full(
+                32, sum(r + 1.0 + i + step for r in range(size)), np.float32)
+            np.testing.assert_allclose(out, expect, rtol=1e-6)
+    stats = hvd.cache_stats()
+    assert stats["size"] == n_tensors, stats
+    # every step after the first should classify as a hit on each rank
+    assert stats["hits"] >= (steps - 2) * n_tensors, stats
+
+
+def scenario_cache_shape_change():
+    rank, size = hvd.rank(), hvd.size()
+    # cache it
+    for _ in range(2):
+        out = hvd.allreduce(np.ones(8, np.float32), name="cs.t", op=hvd.Sum)
+        np.testing.assert_allclose(out, np.full(8, float(size)))
+    # same name, new shape on every rank: must renegotiate cleanly
+    for _ in range(2):
+        out = hvd.allreduce(np.ones((4, 4), np.float32), name="cs.t",
+                            op=hvd.Sum)
+        np.testing.assert_allclose(out, np.full((4, 4), float(size)))
+    # and the new shape becomes the cached one
+    stats = hvd.cache_stats()
+    assert stats["size"] == 1, stats
+    assert stats["hits"] >= 1, stats
+
+
+def scenario_cache_eviction():
+    # HVD_CACHE_CAPACITY=4 set by the test: 10 distinct names per round
+    # churn the cache; correctness must hold and evictions must happen.
+    rank, size = hvd.rank(), hvd.size()
+    for _round in range(3):
+        for i in range(10):
+            out = hvd.allreduce(np.full(4, float(rank), np.float32),
+                                name=f"ev.{i}", op=hvd.Sum)
+            np.testing.assert_allclose(
+                out, np.full(4, sum(float(r) for r in range(size))))
+    stats = hvd.cache_stats()
+    assert stats["capacity"] == 4, stats
+    assert stats["size"] <= 4, stats
+    assert stats["evictions"] > 0, stats
+
+
+def scenario_cache_disabled():
+    rank, size = hvd.rank(), hvd.size()
+    for _ in range(3):
+        out = hvd.allreduce(np.ones(8, np.float32), name="cd.t", op=hvd.Sum)
+        np.testing.assert_allclose(out, np.full(8, float(size)))
+    stats = hvd.cache_stats()
+    assert stats["capacity"] == 0 and stats["hits"] == 0, stats
+
+
 SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
              if k.startswith("scenario_")}
 

@@ -1,0 +1,130 @@
+"""Response-cache tests: unit tests of the LRU structure plus
+multi-process steady-state behavior on both engines (and mixed).
+
+Role parity: the reference has no dedicated cache test file, but its
+cache is exercised by every steady-state allreduce in test_tensorflow.py
+/ test_torch.py; here the behavior is pinned explicitly.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import response_cache as rc
+from horovod_tpu.common.types import (
+    DataType,
+    ReduceOp,
+    Request,
+    RequestType,
+    Response,
+    ResponseType,
+    TensorShape,
+)
+
+from test_multiprocess import ENGINES, run_workers
+
+
+def _req(name, dims=(8,), dtype=DataType.FLOAT32, op=ReduceOp.SUM):
+    return Request(request_rank=0, request_type=RequestType.ALLREDUCE,
+                   tensor_type=dtype, tensor_name=name, device="cpu",
+                   tensor_shape=TensorShape(list(dims)), reduce_op=op)
+
+
+def _resp(names, shapes, dtype=DataType.FLOAT32, op=ReduceOp.SUM):
+    shapes = [TensorShape(list(s)) for s in shapes]
+    return Response(response_type=ResponseType.ALLREDUCE,
+                    tensor_type=dtype, tensor_names=list(names),
+                    devices=["cpu"],
+                    tensor_sizes=[s.num_elements for s in shapes],
+                    reduce_op=op, tensor_shapes=shapes)
+
+
+class TestResponseCacheUnit:
+    def test_miss_then_hit(self):
+        cache = rc.ResponseCache(16)
+        state, _ = cache.classify(_req("a"))
+        assert state == rc.MISS
+        cache.put(_resp(["a"], [(8,)]))
+        state, pos = cache.classify(_req("a"))
+        assert state == rc.HIT
+        assert cache.get_by_position(pos).tensor_names == ["a"]
+        assert cache.position_of("a") == pos
+
+    def test_param_change_is_invalid(self):
+        cache = rc.ResponseCache(16)
+        cache.put(_resp(["a"], [(8,)]))
+        state, _ = cache.classify(_req("a", dims=(4, 2)))
+        assert state == rc.INVALID
+        state, _ = cache.classify(_req("a", op=ReduceOp.MAX))
+        assert state == rc.INVALID
+
+    def test_fused_response_split_per_name(self):
+        cache = rc.ResponseCache(16)
+        cache.put(_resp(["a", "b"], [(8,), (3, 8)]))
+        sa, pa = cache.classify(_req("a"))
+        sb, pb = cache.classify(_req("b", dims=(3, 8)))
+        assert sa == rc.HIT and sb == rc.HIT and pa != pb
+        assert cache.get_by_position(pb).tensor_sizes == [24]
+
+    def test_lru_eviction_and_position_reuse(self):
+        cache = rc.ResponseCache(2)
+        cache.put(_resp(["a"], [(8,)]))
+        cache.put(_resp(["b"], [(8,)]))
+        _, pos_a = cache.classify(_req("a"))  # classify does not touch LRU
+        cache.put(_resp(["c"], [(8,)]))  # evicts LRU = a
+        assert cache.evictions == 1
+        state, _ = cache.classify(_req("a"))
+        assert state == rc.MISS
+        # the freed position was reused for c
+        _, pos_c = cache.classify(_req("c"))
+        assert pos_c == pos_a
+
+    def test_touch_changes_eviction_order(self):
+        cache = rc.ResponseCache(2)
+        cache.put(_resp(["a"], [(8,)]))
+        cache.put(_resp(["b"], [(8,)]))
+        cache.touch(cache.position_of("a"))  # a becomes MRU
+        cache.put(_resp(["c"], [(8,)]))      # evicts b, not a
+        assert cache.position_of("a") >= 0
+        assert cache.position_of("b") == -1
+
+    def test_synthesize_request(self):
+        cache = rc.ResponseCache(4)
+        cache.put(_resp(["a"], [(3, 8)]))
+        _, pos = cache.classify(_req("a", dims=(3, 8)))
+        req = cache.synthesize_request(pos, rank=3)
+        assert req.request_rank == 3
+        assert req.tensor_shape == TensorShape([3, 8])
+        assert req.reduce_op == ReduceOp.SUM
+
+    def test_disabled(self):
+        cache = rc.ResponseCache(0)
+        cache.put(_resp(["a"], [(8,)]))
+        assert len(cache) == 0
+        assert cache.classify(_req("a")) == (rc.MISS, -1)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_cache_steady_state(engine):
+    run_workers("cache_steady_state", 2, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_cache_steady_state_4proc(engine):
+    run_workers("cache_steady_state", 4, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_cache_shape_change(engine):
+    run_workers("cache_shape_change", 2, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_cache_eviction(engine):
+    run_workers("cache_eviction", 2, engine=engine,
+                extra_env={"HVD_CACHE_CAPACITY": "4"})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_cache_disabled(engine):
+    run_workers("cache_disabled", 2, engine=engine,
+                extra_env={"HVD_CACHE_CAPACITY": "0"})

@@ -48,10 +48,12 @@ def test_request_roundtrip():
                 tensor_name="π-名前", root_rank=2,
                 tensor_shape=TensorShape([])),
     ]
-    data = wire.encode_request_list(reqs, shutdown=True)
-    out, shutdown = wire.decode_request_list(data)
+    data = wire.encode_request_list(reqs, shutdown=True,
+                                    cache_hits=[("layer1/w:grad", 7)])
+    out, shutdown, hits = wire.decode_request_list(data)
     assert shutdown is True
     assert out == reqs
+    assert hits == [("layer1/w:grad", 7)]
 
 
 def test_response_roundtrip():
@@ -62,14 +64,19 @@ def test_response_roundtrip():
         Response(response_type=ResponseType.ERROR,
                  tensor_names=["x"], error_message="shape mismatch"),
     ]
-    data = wire.encode_response_list(resps, shutdown=False)
-    out, shutdown = wire.decode_response_list(data)
+    data = wire.encode_response_list(resps, shutdown=False,
+                                     hit_positions=[3, 0],
+                                     resend_names=["x"])
+    out, shutdown, hit_pos, resend = wire.decode_response_list(data)
     assert shutdown is False
     assert out == resps
+    assert hit_pos == [3, 0]
+    assert resend == ["x"]
 
 
 def test_empty_lists():
-    reqs, sd = wire.decode_request_list(wire.encode_request_list([]))
-    assert reqs == [] and sd is False
-    resps, sd = wire.decode_response_list(wire.encode_response_list([]))
-    assert resps == [] and sd is False
+    reqs, sd, hits = wire.decode_request_list(wire.encode_request_list([]))
+    assert reqs == [] and sd is False and hits == []
+    resps, sd, hit_pos, resend = wire.decode_response_list(
+        wire.encode_response_list([]))
+    assert resps == [] and sd is False and hit_pos == [] and resend == []
