@@ -1,0 +1,111 @@
+// Autotuning of coordination-loop knobs: GP regression + expected
+// improvement over (fusion threshold, cycle time, cache on/off), scored
+// by allreduced bytes/sec.
+//
+// Role parity: horovod/common/parameter_manager.cc/.h +
+// optim/bayesian_optimization.cc + optim/gaussian_process.cc (there:
+// Eigen + L-BFGS; here: hand-rolled Cholesky + candidate sweep — sample
+// counts are tens, dimensions ≤ 3).  The Python twin
+// (horovod_tpu/autotune/) is the executable spec; only rank 0 runs the
+// tuner, so the two implementations never need bit-identical decisions.
+#pragma once
+
+#include <cstdint>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// GP posterior over f: [0,1]^d -> R, RBF kernel, fixed hyperparameters.
+class GaussianProcess {
+ public:
+  GaussianProcess(double length_scale = 0.25, double signal_variance = 1.0,
+                  double noise_variance = 1e-4)
+      : ls_(length_scale), sv_(signal_variance), nv_(noise_variance) {}
+
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+  // Posterior mean and stddev at one point (de-standardized).
+  void Predict(const std::vector<double>& x, double* mean,
+               double* stddev) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double ls_, sv_, nv_;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;
+  std::vector<double> chol_;  // lower triangular, row-major n×n
+  double y_mean_ = 0, y_std_ = 1;
+};
+
+class BayesianOptimization {
+ public:
+  explicit BayesianOptimization(int dim, double xi = 0.01,
+                                uint32_t seed = 0, int n_candidates = 512)
+      : dim_(dim), xi_(xi), rng_(seed), n_candidates_(n_candidates) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+  std::vector<double> Best() const;
+  std::vector<double> NextSample();
+  double ExpectedImprovement(const std::vector<double>& x) const;
+  bool empty() const { return ys_.empty(); }
+
+ private:
+  int dim_;
+  double xi_;
+  std::mt19937 rng_;
+  int n_candidates_;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  GaussianProcess gp_;
+};
+
+struct TunedParams {
+  int64_t fusion_threshold = 64 << 20;
+  double cycle_time_s = 0.005;
+  bool cache_enabled = true;
+};
+
+// Rank-0 tuner: feed allreduced bytes, get knob updates to broadcast.
+class ParameterManager {
+ public:
+  struct Options {
+    bool tune_fusion = true;
+    bool tune_cycle = true;
+    bool tune_cache = true;
+    int warmup_samples = 3;
+    int max_samples = 20;
+    double sample_duration_s = 0.5;
+    std::string log_path;
+  };
+
+  ParameterManager(const TunedParams& initial, const Options& opts);
+  ~ParameterManager();
+
+  // Returns true when *out holds new params to apply + broadcast.
+  bool RecordBytes(int64_t nbytes, double now_s, TunedParams* out);
+  bool done() const { return done_; }
+  const TunedParams& current() const { return current_; }
+
+ private:
+  std::vector<double> ParamsToX(const TunedParams& p) const;
+  TunedParams XToParams(const std::vector<double>& x) const;
+  void Log(int sample, double score);
+
+  TunedParams current_;
+  Options opts_;
+  std::vector<std::string> dims_;
+  BayesianOptimization bo_;
+  std::vector<double> current_x_;
+  int warmup_left_;
+  int samples_ = 0;
+  int64_t bytes_ = 0;
+  double sample_start_s_ = -1;
+  bool done_ = false;
+  void* log_file_ = nullptr;  // FILE*
+};
+
+}  // namespace hvd

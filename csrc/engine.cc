@@ -263,6 +263,11 @@ Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
     if (fd >= 0) SetNoDelay(fd);
   last_stall_check_s_ = NowS();
   cache_.SetCapacity(cfg.cache_capacity);
+  if (cfg.autotune && cfg.rank == 0)
+    pm_ = std::make_unique<ParameterManager>(
+        TunedParams{cfg.fusion_threshold, cfg.cycle_time_s,
+                    cfg.cache_capacity > 0},
+        cfg.autotune_opts);
   bg_ = std::thread([this] { BackgroundLoop(); });
 }
 
@@ -514,11 +519,19 @@ void Engine::ClassifyRequests(std::vector<Request> msgs,
       continue;
     }
     uint32_t pos = 0;
-    if (cache_.Classify(req, &pos) == ResponseCache::HIT)
+    if (cache_classify_enabled_ &&
+        cache_.Classify(req, &pos) == ResponseCache::HIT)
       hit_events->push_back({req.tensor_name, pos});
     else
       requests->push_back(std::move(req));
   }
+}
+
+void Engine::ApplyParams(const WireParams& p) {
+  cfg_.fusion_threshold = p.fusion_threshold;
+  cfg_.cycle_time_s = p.cycle_time_s;
+  std::lock_guard<std::mutex> lk(cache_mu_);
+  cache_classify_enabled_ = p.cache_enabled;
 }
 
 void Engine::ExecuteCachedHits(const std::vector<uint32_t>& hit_positions) {
@@ -571,10 +584,14 @@ bool Engine::WorkerCycle(std::vector<Request> msgs) {
     std::vector<Response> responses;
     std::vector<uint32_t> hit_positions;
     std::vector<std::string> resend;
+    WireParams params;
     bool shutdown = false;
     if (!DecodeResponseList(payload.data(), payload.size(), &responses,
-                            &shutdown, &hit_positions, &resend))
+                            &shutdown, &hit_positions, &resend, &params))
       throw SocketError("malformed response list");
+    // Apply BEFORE executing this frame's hits: the fusion threshold
+    // shapes the fused launches, which must match on every rank.
+    if (params.present) ApplyParams(params);
     ProcessResends(resend);
     ExecuteCachedHits(hit_positions);
     for (auto& resp : responses) PerformResponse(resp);
@@ -700,25 +717,57 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
   if (!cfg_.stall_check_disable) shutdown = CheckStalls() || shutdown;
 
   if (!responses.empty() || !hit_positions.empty() || !resend_by_rank.empty() ||
-      shutdown) {
+      have_pending_params_ || shutdown) {
     auto fused = FuseResponses(std::move(responses));
+    WireParams wp;
+    if (have_pending_params_) {
+      wp.present = true;
+      wp.fusion_threshold = pending_params_.fusion_threshold;
+      wp.cycle_time_s = pending_params_.cycle_time_s;
+      wp.cache_enabled = pending_params_.cache_enabled;
+      have_pending_params_ = false;
+    }
     std::vector<uint8_t> shared;
     for (int r = 1; r < cfg_.size; ++r) {
       auto rit = resend_by_rank.find(r);
       if (rit != resend_by_rank.end()) {
-        auto payload =
-            EncodeResponseList(fused, shutdown, hit_positions, rit->second);
+        auto payload = EncodeResponseList(fused, shutdown, hit_positions,
+                                          rit->second, wp);
         SendFrame(ctrl_fds_[r], kTagResponseList, payload.data(),
                   payload.size());
       } else {
         if (shared.empty())
-          shared = EncodeResponseList(fused, shutdown, hit_positions);
+          shared = EncodeResponseList(fused, shutdown, hit_positions, {}, wp);
         SendFrame(ctrl_fds_[r], kTagResponseList, shared.data(),
                   shared.size());
       }
     }
+    // Same ordering contract as the workers: apply before fusing or
+    // executing this frame's cached hits.
+    if (wp.present) ApplyParams(wp);
     ExecuteCachedHits(hit_positions);
     for (auto& resp : fused) PerformResponse(resp);
+    if (pm_ && !pm_->done()) {
+      int64_t nbytes = 0;
+      for (auto& r : fused)
+        if (r.response_type == ResponseType::ALLREDUCE)
+          for (auto s : r.tensor_sizes)
+            nbytes += s * static_cast<int64_t>(ItemSize(r.tensor_type));
+      {
+        std::lock_guard<std::mutex> lk(cache_mu_);
+        for (auto p : hit_positions) {
+          const Response* c = cache_.GetByPosition(p);
+          if (c)
+            nbytes += c->tensor_sizes[0] *
+                      static_cast<int64_t>(ItemSize(c->tensor_type));
+        }
+      }
+      TunedParams next;
+      if (pm_->RecordBytes(nbytes, NowS(), &next)) {
+        pending_params_ = next;
+        have_pending_params_ = true;
+      }
+    }
     if (shutdown) {
       shutdown_.store(true);
       return false;

@@ -36,6 +36,7 @@
 #include <unordered_set>
 #include <vector>
 
+#include "autotune.h"
 #include "types.h"
 #include "wire.h"
 
@@ -92,6 +93,9 @@ struct EngineConfig {
   double stall_shutdown_s = 0.0;
   bool stall_check_disable = false;
   int64_t cache_capacity = 1024;  // 0 disables the response cache
+  // Autotuner (coordinator only; parity: parameter_manager.cc).
+  bool autotune = false;
+  ParameterManager::Options autotune_opts;
 };
 
 // LRU cache of previously negotiated single-tensor ALLREDUCE responses,
@@ -250,10 +254,17 @@ class Engine {
   // except CacheStats which takes cache_mu_.
   std::mutex cache_mu_;
   ResponseCache cache_{1024};
+  bool cache_classify_enabled_ = true;
   std::unordered_set<std::string> resend_uncached_;
   // Coordinator only: ranks whose contribution for a name arrived as a
   // hit event (→ response can be broadcast as a bare position).
   std::unordered_map<std::string, std::set<int>> hit_ranks_;
+
+  // Autotuner (coordinator only; background thread).
+  std::unique_ptr<ParameterManager> pm_;
+  bool have_pending_params_ = false;
+  TunedParams pending_params_;
+  void ApplyParams(const WireParams& p);
 
   // Fusion scratch (parity: fusion_buffer_manager.cc — one lazily grown
   // persistent buffer reused across fused launches).

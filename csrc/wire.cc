@@ -195,7 +195,7 @@ bool DecodeRequestList(const uint8_t* data, size_t len,
 std::vector<uint8_t> EncodeResponseList(
     const std::vector<Response>& resps, bool shutdown,
     const std::vector<uint32_t>& hit_positions,
-    const std::vector<std::string>& resend_names) {
+    const std::vector<std::string>& resend_names, const WireParams& params) {
   std::vector<uint8_t> b;
   PutU8(b, shutdown ? 1 : 0);
   PutU32(b, static_cast<uint32_t>(resps.size()));
@@ -204,13 +204,20 @@ std::vector<uint8_t> EncodeResponseList(
   for (auto p : hit_positions) PutU32(b, p);
   PutU32(b, static_cast<uint32_t>(resend_names.size()));
   for (auto& nm : resend_names) PutStr(b, nm);
+  PutU8(b, params.present ? 1 : 0);
+  if (params.present) {
+    PutI64(b, params.fusion_threshold);
+    PutF64(b, params.cycle_time_s);
+    PutU8(b, params.cache_enabled ? 1 : 0);
+  }
   return b;
 }
 
 bool DecodeResponseList(const uint8_t* data, size_t len,
                         std::vector<Response>* out, bool* shutdown,
                         std::vector<uint32_t>* hit_positions,
-                        std::vector<std::string>* resend_names) {
+                        std::vector<std::string>* resend_names,
+                        WireParams* params) {
   Reader rd{data, len};
   *shutdown = rd.U8() != 0;
   uint32_t n = rd.U32();
@@ -222,6 +229,12 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
   uint32_t n_resend = rd.U32();
   for (uint32_t i = 0; i < n_resend && !rd.fail; ++i)
     resend_names->push_back(rd.Str());
+  params->present = rd.U8() != 0;
+  if (params->present) {
+    params->fusion_threshold = rd.I64();
+    params->cycle_time_s = rd.F64();
+    params->cache_enabled = rd.U8() != 0;
+  }
   return !rd.fail;
 }
 

@@ -65,13 +65,24 @@ bool DecodeRequestList(const uint8_t* data, size_t len,
                        std::vector<Request>* out, bool* shutdown,
                        std::vector<CacheHit>* hits);
 
+// Autotuner knob broadcast riding the response stream (parity: rank-0
+// Params bcast, parameter_manager.cc via controller.cc:33-47).
+struct WireParams {
+  bool present = false;
+  int64_t fusion_threshold = 0;
+  double cycle_time_s = 0;
+  bool cache_enabled = true;
+};
+
 std::vector<uint8_t> EncodeResponseList(
     const std::vector<Response>& resps, bool shutdown,
     const std::vector<uint32_t>& hit_positions = {},
-    const std::vector<std::string>& resend_names = {});
+    const std::vector<std::string>& resend_names = {},
+    const WireParams& params = {});
 bool DecodeResponseList(const uint8_t* data, size_t len,
                         std::vector<Response>* out, bool* shutdown,
                         std::vector<uint32_t>* hit_positions,
-                        std::vector<std::string>* resend_names);
+                        std::vector<std::string>* resend_names,
+                        WireParams* params);
 
 }  // namespace hvd

@@ -1,0 +1,15 @@
+"""Runtime autotuning of coordination-loop knobs.
+
+Role parity: ``horovod/common/parameter_manager.cc/.h`` (tunable knobs,
+warmup/sampling schedule, rank-0-tunes-and-broadcasts) +
+``horovod/common/optim/bayesian_optimization.cc`` and
+``gaussian_process.cc`` (GP regression with expected-improvement
+acquisition).  Scored the same way: bytes processed per unit time.
+"""
+
+from horovod_tpu.autotune.gaussian_process import GaussianProcess  # noqa
+from horovod_tpu.autotune.bayesian import BayesianOptimization  # noqa
+from horovod_tpu.autotune.parameter_manager import (  # noqa
+    ParameterManager,
+    TunedParams,
+)

@@ -1,0 +1,61 @@
+"""Gaussian-process regression for the autotuner.
+
+Role parity: ``horovod/common/optim/gaussian_process.cc/.h`` — GP with an
+RBF kernel fit to (parameter vector → score) samples, used only by the
+Bayesian-optimization autotuner.  The reference uses Eigen + L-BFGS for
+hyperparameter fitting; sample counts here are tiny (tens), so a fixed
+length-scale with numpy Cholesky is accurate enough and dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """GP posterior over f: [0,1]^d -> R with RBF kernel."""
+
+    def __init__(self, length_scale: float = 0.25,
+                 signal_variance: float = 1.0,
+                 noise_variance: float = 1e-4):
+        self.length_scale = length_scale
+        self.signal_variance = signal_variance
+        self.noise_variance = noise_variance
+        self._x: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        # squared exponential: k(x,x') = s² exp(-‖x-x'‖² / (2ℓ²))
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_variance * np.exp(-0.5 * d2 /
+                                             (self.length_scale ** 2))
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64).ravel()
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        yn = (y - self._y_mean) / self._y_std
+        k = self._kernel(x, x) + self.noise_variance * np.eye(len(x))
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, yn))
+        self._x = x
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and stddev at x (de-standardized)."""
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return (np.full(len(x), self._y_mean),
+                    np.full(len(x), np.sqrt(self.signal_variance)))
+        ks = self._kernel(x, self._x)
+        mean = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.clip(self.signal_variance - (v ** 2).sum(0), 1e-12, None)
+        return (mean * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)

@@ -25,7 +25,15 @@ Layout (all integers little-endian):
               u32 n_shapes, { u8 ndim, i64 dims[ndim] }[n_shapes]
   ResponseList := u8 shutdown, u32 n, Response[n],
                   u32 n_hit_positions, u32 pos[n_hit_positions],
-                  u32 n_resend, varstr resend_names[n_resend]
+                  u32 n_resend, varstr resend_names[n_resend],
+                  u8 has_params,
+                  [ i64 fusion_threshold, f64 cycle_time_s,
+                    u8 cache_enabled ]   # iff has_params
+
+``has_params`` carries the autotuner's knob broadcast (parity: rank 0
+tuning + Params bcast, ``parameter_manager.cc`` via ``controller.cc:33-47``);
+workers must apply it before executing the same frame's cached hits so
+hit fusion stays coherent.
 
 The cache fields carry the response-cache fast path (parity:
 ``horovod/common/response_cache.h:45-167`` — there a fixed-width
@@ -37,7 +45,7 @@ coordinator and hit positions back down, see
 from __future__ import annotations
 
 import struct
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from horovod_tpu.common.types import (
     DataType,
@@ -212,7 +220,11 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
 
 def encode_response_list(resps: List[Response], shutdown: bool = False,
                          hit_positions: List[int] = (),
-                         resend_names: List[str] = ()) -> bytes:
+                         resend_names: List[str] = (),
+                         params: Optional[Tuple[int, float, bool]] = None
+                         ) -> bytes:
+    """``params``: (fusion_threshold, cycle_time_s, cache_enabled) knob
+    broadcast from the autotuner, or None."""
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(resps))
     for r in resps:
@@ -223,11 +235,18 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
     buf += struct.pack("<I", len(resend_names))
     for nm in resend_names:
         _pack_str(buf, nm)
+    if params is None:
+        buf += struct.pack("<B", 0)
+    else:
+        fusion, cycle_s, cache_on = params
+        buf += struct.pack("<BqdB", 1, fusion, cycle_s,
+                           1 if cache_on else 0)
     return bytes(buf)
 
 
-def decode_response_list(
-        data: bytes) -> Tuple[List[Response], bool, List[int], List[str]]:
+def decode_response_list(data: bytes) -> Tuple[
+        List[Response], bool, List[int], List[str],
+        Optional[Tuple[int, float, bool]]]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
@@ -247,4 +266,11 @@ def decode_response_list(
     for _ in range(n_resend):
         nm, off = _unpack_str(data, off)
         resend.append(nm)
-    return out, bool(shutdown), hits, resend
+    (has_params,) = struct.unpack_from("<B", data, off)
+    off += 1
+    params = None
+    if has_params:
+        fusion, cycle_s, cache_on = struct.unpack_from("<qdB", data, off)
+        off += struct.calcsize("<qdB")
+        params = (fusion, cycle_s, bool(cache_on))
+    return out, bool(shutdown), hits, resend, params

@@ -25,8 +25,10 @@ _CSRC_DIR = _PKG_DIR.parent / "csrc"
 
 _lib: Optional[ctypes.CDLL] = None
 
-_SOURCES = ("wire.cc", "sockets.cc", "kernels.cc", "engine.cc", "c_api.cc")
-_HEADERS = ("types.h", "wire.h", "sockets.h", "kernels.h", "engine.h")
+_SOURCES = ("wire.cc", "sockets.cc", "kernels.cc", "autotune.cc",
+            "engine.cc", "c_api.cc")
+_HEADERS = ("types.h", "wire.h", "sockets.h", "kernels.h", "autotune.h",
+            "engine.h")
 
 
 class NativeUnavailable(ImportError):
@@ -76,6 +78,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
         c.POINTER(c.c_int32), c.POINTER(c.c_int32),
         c.c_double, c.c_int64, c.c_double, c.c_double, c.c_int, c.c_int64,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
+        c.c_char_p,
     ]
     lib.hvd_create.restype = c.c_int
     lib.hvd_cache_stats.argtypes = [c.POINTER(c.c_int64)]

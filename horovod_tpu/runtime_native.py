@@ -19,6 +19,7 @@ negotiation, so the core owns them until ``synchronize`` copies them out.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -87,7 +88,15 @@ class NativeEngine:
             env_util.get_float(env_util.STALL_SHUTDOWN_TIME, 0.0),
             1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
             else 0,
-            env_util.get_int(env_util.CACHE_CAPACITY, 1024))
+            env_util.get_int(env_util.CACHE_CAPACITY, 1024),
+            1 if env_util.get_bool(env_util.AUTOTUNE, False) else 0,
+            0 if env_util.FUSION_THRESHOLD in os.environ else 1,
+            0 if env_util.CYCLE_TIME in os.environ else 1,
+            0 if env_util.CACHE_CAPACITY in os.environ else 1,
+            env_util.get_int(env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
+            env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
+            env_util.get_float(env_util.AUTOTUNE_SAMPLE_DURATION, 0.5),
+            env_util.get_str(env_util.AUTOTUNE_LOG).encode() or None)
         if rc != 0:
             raise OSError(self._lib.hvd_last_error().decode())
 

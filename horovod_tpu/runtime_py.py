@@ -294,8 +294,19 @@ class PyEngine(_EngineBase):
         # All cache state is touched only on the background thread.
         self._cache = rcache.ResponseCache(
             env_util.get_int(env_util.CACHE_CAPACITY, 1024))
+        self._cache_classify_enabled = True
         self._resend_uncached: set = set()
         self._hit_ranks: Dict[str, set] = {}
+
+        # autotuner (coordinator only; parity: parameter_manager.cc —
+        # rank 0 tunes and broadcasts).
+        self._pm = None
+        if rank == 0:
+            from horovod_tpu.autotune import ParameterManager
+
+            self._pm = ParameterManager.from_env(
+                self.fusion_threshold, self.cycle_time)
+        self._pending_params = None
 
         self._bootstrap(rdv_addr, rdv_port)
 
@@ -522,6 +533,9 @@ class PyEngine(_EngineBase):
                 self._resend_uncached.discard(req.tensor_name)
                 requests.append(req)
                 continue
+            if not self._cache_classify_enabled:
+                requests.append(req)
+                continue
             state, pos = self._cache.classify(req)
             if state == rcache.HIT:
                 hits.append((req.tensor_name, pos))
@@ -580,8 +594,13 @@ class PyEngine(_EngineBase):
             inbox = self._response_inbox
             self._response_inbox = []
         for payload in inbox:
-            responses, shutdown, hit_positions, resend = \
+            responses, shutdown, hit_positions, resend, params = \
                 wire.decode_response_list(payload)
+            if params is not None:
+                # Apply BEFORE executing this frame's hits: the fusion
+                # threshold shapes the fused launches, which must be
+                # identical on every rank.
+                self._apply_params(params)
             self._process_resends(resend)
             self._execute_cached_hits(hit_positions)
             for resp in responses:
@@ -590,6 +609,12 @@ class PyEngine(_EngineBase):
                 self._shutdown_flag.set()
                 return False
         return True
+
+    def _apply_params(self, params) -> None:
+        fusion, cycle_s, cache_on = params
+        self.fusion_threshold = fusion
+        self.cycle_time = cycle_s
+        self._cache_classify_enabled = cache_on
 
     # -- coordinator ----------------------------------------------------
 
@@ -676,28 +701,52 @@ class PyEngine(_EngineBase):
         if not self.stall_check_disable:
             shutdown = self._check_stalls() or shutdown
 
-        if responses or hit_positions or resend_by_rank or shutdown:
+        tuned = self._pending_params
+        if responses or hit_positions or resend_by_rank or shutdown \
+                or tuned is not None:
             fused = self._fuse_responses(responses)
+            params = None
+            if tuned is not None:
+                params = (tuned.fusion_threshold, tuned.cycle_time_s,
+                          tuned.cache_enabled)
+                self._pending_params = None
             shared = None
             for r, s in self._ctrl_socks.items():
                 resend = resend_by_rank.get(r, [])
                 if resend:
                     payload = wire.encode_response_list(
                         fused, shutdown=shutdown,
-                        hit_positions=hit_positions, resend_names=resend)
+                        hit_positions=hit_positions, resend_names=resend,
+                        params=params)
                 else:
                     if shared is None:
                         shared = wire.encode_response_list(
                             fused, shutdown=shutdown,
-                            hit_positions=hit_positions)
+                            hit_positions=hit_positions, params=params)
                     payload = shared
                 try:
                     su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
                 except (ConnectionError, OSError):
                     pass
+            if params is not None:
+                # Same ordering contract as the workers: apply before
+                # fusing/executing this frame's cached hits.
+                self._apply_params(params)
             self._execute_cached_hits(hit_positions)
             for resp in fused:
                 self._perform_operation(resp)
+            if self._pm is not None and not self._pm.done:
+                nbytes = sum(
+                    sum(r.tensor_sizes) * r.tensor_type.itemsize
+                    for r in fused
+                    if r.response_type == ResponseType.ALLREDUCE)
+                nbytes += sum(
+                    c.tensor_sizes[0] * c.tensor_type.itemsize
+                    for c in map(self._cache.get_by_position, hit_positions)
+                    if c is not None)
+                new = self._pm.record_bytes(nbytes)
+                if new is not None:
+                    self._pending_params = new
             if shutdown:
                 self._shutdown_flag.set()
                 return False

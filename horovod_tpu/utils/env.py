@@ -23,6 +23,9 @@ STALL_CHECK_TIME = "HVD_STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME = "HVD_STALL_SHUTDOWN_TIME_SECONDS"
 AUTOTUNE = "HVD_AUTOTUNE"
 AUTOTUNE_LOG = "HVD_AUTOTUNE_LOG"
+AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
+AUTOTUNE_MAX_SAMPLES = "HVD_AUTOTUNE_MAX_SAMPLES"      # BAYES_OPT_MAX_SAMPLES
+AUTOTUNE_SAMPLE_DURATION = "HVD_AUTOTUNE_SAMPLE_DURATION_SECONDS"
 ADASUM_MODE = "HVD_ADASUM_MODE"
 
 

@@ -244,6 +244,22 @@ def scenario_cache_eviction():
     assert stats["evictions"] > 0, stats
 
 
+def scenario_autotune():
+    # Enough steady-state traffic for the tuner (tiny sample windows set
+    # by the test) to warm up, take its samples, and settle — while every
+    # result stays correct through knob changes mid-run.
+    rank, size = hvd.rank(), hvd.size()
+    for step in range(120):
+        handles = [hvd.allreduce_async(
+            np.full(1024, rank + 1.0 + i, np.float32),
+            name=f"at.t{i}", op=hvd.Sum) for i in range(8)]
+        for i, h in enumerate(handles):
+            out = hvd.synchronize(h)
+            expect = np.full(
+                1024, sum(r + 1.0 + i for r in range(size)), np.float32)
+            np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
 def scenario_cache_disabled():
     rank, size = hvd.rank(), hvd.size()
     for _ in range(3):

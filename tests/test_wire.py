@@ -67,16 +67,35 @@ def test_response_roundtrip():
     data = wire.encode_response_list(resps, shutdown=False,
                                      hit_positions=[3, 0],
                                      resend_names=["x"])
-    out, shutdown, hit_pos, resend = wire.decode_response_list(data)
+    out, shutdown, hit_pos, resend, params = wire.decode_response_list(data)
     assert shutdown is False
     assert out == resps
     assert hit_pos == [3, 0]
     assert resend == ["x"]
+    assert params is None
+
+
+def test_response_list_params_roundtrip():
+    data = wire.encode_response_list(
+        [], params=(32 << 20, 0.0035, False))
+    _, _, _, _, params = wire.decode_response_list(data)
+    assert params == (32 << 20, 0.0035, False)
+
+
+def test_response_shapes_roundtrip():
+    resp = Response(response_type=ResponseType.ALLREDUCE,
+                    tensor_names=["a", "b"], tensor_type=DataType.FLOAT32,
+                    devices=["cpu"], tensor_sizes=[24, 4],
+                    tensor_shapes=[TensorShape([3, 8]), TensorShape([4])])
+    data = wire.encode_response_list([resp])
+    out, _, _, _, _ = wire.decode_response_list(data)
+    assert out[0].tensor_shapes == [TensorShape([3, 8]), TensorShape([4])]
 
 
 def test_empty_lists():
     reqs, sd, hits = wire.decode_request_list(wire.encode_request_list([]))
     assert reqs == [] and sd is False and hits == []
-    resps, sd, hit_pos, resend = wire.decode_response_list(
+    resps, sd, hit_pos, resend, params = wire.decode_response_list(
         wire.encode_response_list([]))
     assert resps == [] and sd is False and hit_pos == [] and resend == []
+    assert params is None
