@@ -116,12 +116,16 @@ std::vector<double> BayesianOptimization::Best() const {
 
 double BayesianOptimization::ExpectedImprovement(
     const std::vector<double>& x) const {
-  double mean, std;
-  gp_.Predict(x, &mean, &std);
+  double mean, sd;
+  gp_.Predict(x, &mean, &sd);
   double best = ys_.empty() ? 0.0 : *std::max_element(ys_.begin(), ys_.end());
-  double imp = mean - best - xi_;
-  double z = imp / std;
-  return imp * NormalCdf(z) + std * NormalPdf(z);
+  // Standardized scale, so the xi exploration bonus is meaningful at any
+  // raw score magnitude (bytes/sec is ~1e8).
+  double y_std = gp_.y_std();
+  double imp = (mean - best) / y_std - xi_;
+  double sds = sd / y_std;
+  double z = imp / sds;
+  return imp * NormalCdf(z) + sds * NormalPdf(z);
 }
 
 std::vector<double> BayesianOptimization::NextSample() {

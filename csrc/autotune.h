@@ -29,6 +29,8 @@ class GaussianProcess {
   // Posterior mean and stddev at one point (de-standardized).
   void Predict(const std::vector<double>& x, double* mean,
                double* stddev) const;
+  // Scale of the standardized targets (1.0 before the first Fit).
+  double y_std() const { return y_std_; }
 
  private:
   double Kernel(const std::vector<double>& a,

@@ -56,9 +56,14 @@ class BayesianOptimization:
     def expected_improvement(self, x: np.ndarray) -> np.ndarray:
         mean, std = self.gp.predict(x)
         best = max(self._ys) if self._ys else 0.0
-        imp = mean - best - self.xi
-        z = imp / std
-        return imp * _normal_cdf(z) + std * _normal_pdf(z)
+        # Work on the GP's standardized scale so the xi exploration bonus
+        # is meaningful regardless of the raw score units (bytes/sec is
+        # ~1e8; raw xi=0.01 would be vacuous).
+        y_std = self.gp.y_std
+        imp = (mean - best) / y_std - self.xi
+        sd = std / y_std
+        z = imp / sd
+        return imp * _normal_cdf(z) + sd * _normal_pdf(z)
 
     def next_sample(self) -> np.ndarray:
         """Candidate with the highest EI (random sweep + past-best jitter)."""

@@ -47,6 +47,11 @@ class GaussianProcess:
             self._chol.T, np.linalg.solve(self._chol, yn))
         self._x = x
 
+    @property
+    def y_std(self) -> float:
+        """Scale of the standardized targets (1.0 before the first fit)."""
+        return self._y_std
+
     def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """Posterior mean and stddev at x (de-standardized)."""
         x = np.atleast_2d(np.asarray(x, np.float64))

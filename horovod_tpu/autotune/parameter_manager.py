@@ -39,6 +39,28 @@ _MIN_CYCLE_S = 0.0005
 _MAX_CYCLE_S = 0.025
 
 
+def autotune_options_from_env() -> Optional[dict]:
+    """The single source of the autotune env policy, shared by the Python
+    engine (ParameterManager.from_env) and the native engine (which ships
+    these values through hvd_create).  None when tuning is off or every
+    knob is env-pinned."""
+    if not env_util.get_bool(env_util.AUTOTUNE, False):
+        return None
+    opts = dict(
+        tune_fusion=env_util.FUSION_THRESHOLD not in os.environ,
+        tune_cycle=env_util.CYCLE_TIME not in os.environ,
+        tune_cache=env_util.CACHE_CAPACITY not in os.environ,
+        warmup_samples=env_util.get_int(env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
+        max_samples=env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
+        sample_duration_s=env_util.get_float(
+            env_util.AUTOTUNE_SAMPLE_DURATION, 0.5),
+        log_path=env_util.get_str(env_util.AUTOTUNE_LOG) or None,
+    )
+    if not (opts["tune_fusion"] or opts["tune_cycle"] or opts["tune_cache"]):
+        return None
+    return opts
+
+
 @dataclass
 class TunedParams:
     """The knob vector shipped coordinator → workers."""
@@ -91,26 +113,10 @@ class ParameterManager:
                  cycle_time_s: float) -> Optional["ParameterManager"]:
         """None unless HVD_AUTOTUNE is on.  Env-pinned knobs are fixed;
         if every knob is pinned there is nothing to tune."""
-        if not env_util.get_bool(env_util.AUTOTUNE, False):
+        opts = autotune_options_from_env()
+        if opts is None:
             return None
-        tune_fusion = env_util.FUSION_THRESHOLD not in os.environ
-        tune_cycle = env_util.CYCLE_TIME not in os.environ
-        tune_cache = env_util.CACHE_CAPACITY not in os.environ
-        if not (tune_fusion or tune_cycle or tune_cache):
-            return None
-        initial = TunedParams(fusion_threshold, cycle_time_s, True)
-        return cls(
-            initial,
-            tune_fusion=tune_fusion,
-            tune_cycle=tune_cycle,
-            tune_cache=tune_cache,
-            warmup_samples=env_util.get_int(
-                env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
-            max_samples=env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
-            sample_duration_s=env_util.get_float(
-                env_util.AUTOTUNE_SAMPLE_DURATION, 0.5),
-            log_path=env_util.get_str(env_util.AUTOTUNE_LOG) or None,
-        )
+        return cls(TunedParams(fusion_threshold, cycle_time_s, True), **opts)
 
     # -- parameter vector mapping ----------------------------------------
 

@@ -19,7 +19,6 @@ negotiation, so the core owns them until ``synchronize`` copies them out.
 from __future__ import annotations
 
 import ctypes
-import os
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -89,20 +88,32 @@ class NativeEngine:
             1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
             else 0,
             env_util.get_int(env_util.CACHE_CAPACITY, 1024),
-            1 if env_util.get_bool(env_util.AUTOTUNE, False) else 0,
-            0 if env_util.FUSION_THRESHOLD in os.environ else 1,
-            0 if env_util.CYCLE_TIME in os.environ else 1,
-            0 if env_util.CACHE_CAPACITY in os.environ else 1,
-            env_util.get_int(env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
-            env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
-            env_util.get_float(env_util.AUTOTUNE_SAMPLE_DURATION, 0.5),
-            env_util.get_str(env_util.AUTOTUNE_LOG).encode() or None)
+            *self._autotune_args())
         if rc != 0:
             raise OSError(self._lib.hvd_last_error().decode())
 
         self._meta: Dict[int, _HandleMeta] = {}
         self._meta_lock = threading.Lock()
         self._shutdown = False
+
+    @staticmethod
+    def _autotune_args():
+        """hvd_create's autotune tail, from the shared env policy (single
+        source: autotune.parameter_manager.autotune_options_from_env)."""
+        from horovod_tpu.autotune.parameter_manager import (
+            autotune_options_from_env,
+        )
+
+        opts = autotune_options_from_env()
+        if opts is None:
+            return (0, 0, 0, 0, 0, 0, 0.0, None)
+        return (1,
+                1 if opts["tune_fusion"] else 0,
+                1 if opts["tune_cycle"] else 0,
+                1 if opts["tune_cache"] else 0,
+                opts["warmup_samples"], opts["max_samples"],
+                opts["sample_duration_s"],
+                opts["log_path"].encode() if opts["log_path"] else None)
 
     # -- enqueue -----------------------------------------------------------
 

@@ -1,0 +1,312 @@
+"""PyTorch front-end: ``import horovod_tpu.torch as hvd``.
+
+Role parity: ``horovod/torch/__init__.py`` — the classic Horovod torch
+surface (init/rank/size, sync+async+in-place collectives, autograd
+support, hook-driven ``DistributedOptimizer`` overlapping allreduce with
+backward, ``broadcast_parameters`` / ``broadcast_optimizer_state`` /
+``broadcast_object``, ``join``) on top of the horovod_tpu coordination
+engine.  Eager torch tensors bridge zero-copy to the engine as numpy
+views; there is no separate native extension because the engine itself
+is the native core.
+"""
+
+from __future__ import annotations
+
+import collections
+from contextlib import contextmanager
+
+import torch
+
+from horovod_tpu.basics import (  # noqa: F401
+    cache_stats,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    gloo_built,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+    xla_built,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    join,
+    poll,
+    synchronize,
+)
+from horovod_tpu.common.types import ReduceOp
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin applied to the user's optimizer class by
+    ``DistributedOptimizer`` (parity: torch/__init__.py:38-222 — a
+    dynamically created subclass with per-parameter grad-accumulator
+    hooks that fire async allreduces during backward; ``step()`` is the
+    synchronization barrier)."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1, op=ReduceOp.AVERAGE):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.op = op
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}.{j}", v)
+                for i, group in enumerate(self.param_groups)
+                for j, v in enumerate(group["params"])]
+        # Parity checks (torch/__init__.py:60-86): names must be unique
+        # and cover every parameter.
+        if len({k for k, _ in named_parameters}) < len(named_parameters):
+            raise ValueError(
+                "parameter names in named_parameters must be unique")
+        all_params = {v for group in self.param_groups
+                      for v in group["params"]}
+        named = {v for _, v in named_parameters}
+        if all_params - named:
+            raise ValueError(
+                "named_parameters was specified, but one or more model "
+                "parameters were not named")
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles = {}
+        self._ctxs = {}
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._allreduce_delay = {}
+        if size() > 1:
+            self._register_hooks()
+
+    # -- hooks ------------------------------------------------------------
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    # The public post-accumulate hook (torch>=2.1) fires at
+                    # the same point as the reference's grad-accumulator
+                    # hook (torch/__init__.py:127-162).
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+
+    def _make_hook(self):
+        def hook(p):
+            if p in self._handles and self._handles[p] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally.")
+            assert not p.grad.requires_grad
+            assert self._allreduce_delay[p] > 0
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names[p]
+        compressed, ctx = self._compression.compress(p.grad)
+        self._ctxs[p] = ctx
+        return allreduce_async(compressed, name=f"allreduce.{name}",
+                               op=self.op)
+
+    # -- synchronization --------------------------------------------------
+
+    def synchronize(self):
+        """Waits for every outstanding gradient allreduce and writes the
+        reduced values into param.grad (parity: __init__.py:164-201)."""
+        missing = [p for p in self._requires_update
+                   if p not in self._handles]
+        for p in missing:
+            if p.grad is None:
+                p.grad = p.data.new_zeros(p.shape)
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, handle in self._handles.items():
+            output = synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            with torch.no_grad():
+                p.grad.copy_(
+                    self._compression.decompress(output, self._ctxs.pop(p)))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Use when calling ``synchronize()`` manually before ``step()``
+        (parity: __init__.py:203-214)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called without "
+                    "optimizer.skip_synchronize() context after "
+                    "optimizer.synchronize(). This can cause training "
+                    "slowdown. You may want to consider using "
+                    "optimizer.skip_synchronize() context if you use "
+                    "optimizer.synchronize() in your code.")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition.")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1,
+                         op=ReduceOp.AVERAGE):
+    """Wraps a torch optimizer: gradient allreduce overlaps backward;
+    ``step()`` synchronizes (parity: torch/__init__.py:394-449, same
+    dynamic-subclass technique)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op)
+
+
+# ---------------------------------------------------------------------------
+# state broadcast helpers
+# ---------------------------------------------------------------------------
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcasts a ``state_dict()`` or iterable of (name, tensor) from
+    root to all ranks, in place (parity: torch/__init__.py:451-481)."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        params = list(params)
+    handles = []
+    for name, p in params:
+        if p is None:
+            continue
+        if torch.is_tensor(p):
+            handles.append(broadcast_async_(p, root_rank,
+                                            name=f"bp.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcasts the optimizer state (momentum buffers, step counters,
+    hyperparameters) from root (parity: torch/__init__.py:483-604 —
+    tensors broadcast in place, scalars via broadcast_object)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+    if rank() == root_rank and not state_dict["state"]:
+        # Reference behavior: initialize state on root by stepping with
+        # zero gradients so there is something to broadcast.  Only the
+        # root steps here, so a wrapped optimizer must skip its gradient
+        # synchronization or it would launch a one-rank allreduce.
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new_zeros(p.shape)
+        if hasattr(optimizer, "skip_synchronize"):
+            with optimizer.skip_synchronize():
+                optimizer.step()
+        else:
+            optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    # Scalars (incl. param_group hyperparameters) travel as one pickled
+    # object that also carries tensor metadata — non-root ranks may have
+    # no state yet, so they learn shapes/dtypes from the root and
+    # allocate receive buffers; tensors are then broadcast in place.
+    if rank() == root_rank:
+        meta = {"param_groups": state_dict["param_groups"], "state": {}}
+        for pid, pstate in state_dict["state"].items():
+            meta["state"][pid] = {}
+            for key, value in pstate.items():
+                if torch.is_tensor(value):
+                    meta["state"][pid][key] = (
+                        "tensor", value.dtype, tuple(value.shape))
+                else:
+                    meta["state"][pid][key] = ("scalar", value)
+    else:
+        meta = None
+    meta = broadcast_object(meta, root_rank,
+                            name="broadcast_optimizer_state")
+
+    tensors = []
+    new_state = {}
+    own_state = state_dict["state"]
+    for pid, pstate in meta["state"].items():
+        new_state[pid] = {}
+        for key, entry in pstate.items():
+            if entry[0] == "tensor":
+                _, dtype, shape = entry
+                if rank() == root_rank:
+                    t = own_state[pid][key]
+                else:
+                    t = torch.zeros(shape, dtype=dtype)
+                tensors.append((f"opt.{pid}.{key}", t))
+                new_state[pid][key] = t
+            else:
+                new_state[pid][key] = entry[1]
+    broadcast_parameters(tensors, root_rank)
+    if rank() != root_rank:
+        optimizer.load_state_dict({"state": new_state,
+                                   "param_groups": meta["param_groups"]})
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Serializes and broadcasts an arbitrary picklable object from root
+    (parity: torch/__init__.py:607-648).  One implementation serves every
+    front-end: the framework-agnostic pickle-over-broadcast in
+    ``horovod_tpu.ops.eager`` (torch tensors pickle fine)."""
+    from horovod_tpu.ops.eager import broadcast_object as _impl
+
+    return _impl(obj, root_rank, name)

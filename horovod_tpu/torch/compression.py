@@ -1,0 +1,60 @@
+"""Gradient compression for the PyTorch binding.
+
+Parity: ``horovod/torch/compression.py:20-75`` — a Compressor interface
+with ``none`` and ``fp16`` implementations operating on torch tensors.
+``fp16`` here is IEEE half (torch-native), matching the reference; the
+JAX-side ``horovod_tpu.ops.compression`` defaults to bfloat16 because
+that is the TPU wire/MXU-native 16-bit type.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface: compress before the collective, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Float tensors travel as fp16 and are restored to their original
+    dtype afterwards; non-float tensors pass through untouched
+    (parity: compression.py:47-61)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point and tensor.dtype != torch.float16:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Optional gradient compression algorithms used during allreduce."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

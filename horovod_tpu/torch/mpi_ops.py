@@ -1,0 +1,293 @@
+"""PyTorch collective ops over the horovod_tpu coordination engine.
+
+Role parity: ``horovod/torch/mpi_ops.py`` (the Python surface) +
+``horovod/torch/mpi_ops_v2.cc`` (handles, async enqueue) — sync, async,
+and in-place variants of allreduce / allgather / broadcast / alltoall,
+``poll``/``synchronize`` on integer handles, ``join``, and autograd
+support.  Instead of a pybind11 extension the torch tensors bridge to
+the engine through numpy views; the handle registry, name counters, and
+op resolution are shared with the framework-agnostic eager layer
+(``horovod_tpu.ops.eager``) so a handle from either front-end can be
+synchronized by the other.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import torch
+
+from horovod_tpu import basics
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.ops.eager import (
+    _auto_name,
+    _register,
+    _resolve_op,
+    poll,  # noqa: F401  (re-exported)
+    synchronize,  # noqa: F401  (re-exported)
+)
+
+# Reference-named ReduceOp constants (mpi_ops.py re-exports these).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    if tensor.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch eager collectives operate on CPU tensors; "
+            f"got device {tensor.device}")
+    t = tensor.detach()
+    if not t.is_contiguous():
+        t = t.contiguous()
+    return np.ascontiguousarray(t.numpy())
+
+
+def join() -> int:
+    """Signals that this rank is out of data; blocks until every rank
+    joins.  Returns the last joined rank (parity: mpi_ops.py:494-510)."""
+    return basics._engine().join()
+
+
+def barrier() -> None:
+    basics._engine().barrier()
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    rop = _resolve_op(op, average)
+    arr = _to_numpy(tensor)
+    h = basics._engine().allreduce_async(
+        _auto_name("torch.allreduce", name), arr, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor)
+
+    def finalize(result):
+        return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
+            .to(tensor.dtype)
+
+    return _register(h, finalize)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """In-place: the reduced values are written back into `tensor`."""
+    rop = _resolve_op(op, average)
+    arr = _to_numpy(tensor)
+    h = basics._engine().allreduce_async(
+        _auto_name("torch.allreduce", name), arr, op=rop,
+        prescale=prescale_factor, postscale=postscale_factor)
+
+    def finalize(result):
+        out = torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
+            .to(tensor.dtype)
+        with torch.no_grad():
+            tensor.copy_(out)
+        return tensor
+
+    return _register(h, finalize)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    """Parity: mpi_ops.py HorovodAllreduce — the gradient of an
+    allreduce is the same allreduce of the upstream gradient."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale, postscale):
+        ctx.average = average
+        ctx.op = op
+        ctx.prescale = prescale
+        ctx.postscale = postscale
+        return synchronize(allreduce_async(tensor, average, name, op,
+                                           prescale, postscale))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        reduced = _HorovodAllreduce.apply(
+            grad_output, ctx.average, None, ctx.op, ctx.prescale,
+            ctx.postscale)
+        return reduced, None, None, None, None, None
+
+
+def allreduce(tensor, average=None, name=None, compression=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+    """Differentiable allreduce returning a new tensor."""
+    from horovod_tpu.torch.compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    reduced = _HorovodAllreduce.apply(
+        compressed, average, _auto_name("torch.allreduce", name), op,
+        prescale_factor, postscale_factor)
+    return compression.decompress(reduced, ctx)
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, op,
+                                        prescale_factor, postscale_factor))
+
+
+def grouped_allreduce_async(tensors, average=None, name=None,
+                            op=None) -> list:
+    base = _auto_name("torch.grouped", name)
+    return [allreduce_async(t, average, f"{base}.{i}", op)
+            for i, t in enumerate(tensors)]
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None) -> list:
+    return [synchronize(h)
+            for h in grouped_allreduce_async(tensors, average, name, op)]
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+
+def allgather_async(tensor, name=None) -> int:
+    arr = _to_numpy(tensor)
+    h = basics._engine().allgather_async(
+        _auto_name("torch.allgather", name), arr)
+    tail_shape = tuple(tensor.shape[1:]) if tensor.dim() > 0 else ()
+
+    def finalize(result):
+        out = torch.from_numpy(np.asarray(result))
+        if tail_shape:
+            out = out.reshape(-1, *tail_shape)
+        return out.to(tensor.dtype)
+
+    return _register(h, finalize)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    """Parity: mpi_ops.py HorovodAllgather — backward allreduces the
+    gradient and narrows to this rank's segment.  First dims may differ
+    per rank, so the true offset comes from gathering the per-rank
+    sizes, like the reference's grad_fn."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = _HorovodAllreduce.apply(
+            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0)
+        sizes = synchronize(allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int64), None))
+        offset = int(sizes[:basics.rank()].sum())
+        return grad_reduced.narrow(0, offset, ctx.dim0), None
+
+
+def allgather(tensor, name=None) -> torch.Tensor:
+    """Differentiable allgather: concatenation along dim 0 across ranks
+    (first dims may differ per rank)."""
+    return _HorovodAllgather.apply(tensor,
+                                   _auto_name("torch.allgather", name))
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    arr = _to_numpy(tensor)
+    h = basics._engine().broadcast_async(
+        _auto_name("torch.broadcast", name), arr, root_rank=root_rank)
+
+    def finalize(result):
+        return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
+            .to(tensor.dtype)
+
+    return _register(h, finalize)
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    arr = _to_numpy(tensor)
+    h = basics._engine().broadcast_async(
+        _auto_name("torch.broadcast", name), arr, root_rank=root_rank)
+
+    def finalize(result):
+        out = torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
+            .to(tensor.dtype)
+        with torch.no_grad():
+            tensor.copy_(out)
+        return tensor
+
+    return _register(h, finalize)
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    """Parity: mpi_ops.py HorovodBroadcast — backward sums gradients to
+    the root; non-root ranks receive zero."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = _HorovodAllreduce.apply(
+            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0)
+        if basics.rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None
+
+
+def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
+    return _HorovodBroadcast.apply(tensor, root_rank,
+                                   _auto_name("torch.broadcast", name))
+
+
+def broadcast_(tensor, root_rank, name=None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    arr = _to_numpy(tensor)
+    np_splits = None if splits is None else [int(s) for s in splits]
+    h = basics._engine().alltoall_async(
+        _auto_name("torch.alltoall", name), arr, splits=np_splits)
+    tail_shape = tuple(tensor.shape[1:]) if tensor.dim() > 0 else ()
+    want_splits = splits is not None
+
+    def finalize(result):
+        if isinstance(result, tuple):
+            data, recv_splits = result
+        else:
+            # size-1 engine returns the bare array; you receive exactly
+            # what you sent, so the recv splits are the send splits.
+            data, recv_splits = result, np_splits
+        out = torch.from_numpy(np.asarray(data))
+        if tail_shape:
+            out = out.reshape(-1, *tail_shape)
+        out = out.to(tensor.dtype)
+        if not want_splits:
+            return out
+        return out, torch.tensor(list(recv_splits), dtype=torch.int64)
+
+    return _register(h, finalize)
+
+
+def alltoall(tensor, splits=None, name=None):
+    """Returns (gathered, received_splits) when splits are given, else
+    just the gathered tensor."""
+    return synchronize(alltoall_async(tensor, splits, name))

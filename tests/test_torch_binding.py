@@ -1,0 +1,163 @@
+"""PyTorch binding tests, single-process and multi-process.
+
+Role parity: ``test/test_torch.py`` (op matrix, async handles, in-place,
+gradient correctness, DistributedOptimizer behaviors, broadcast of
+parameters/optimizer state/objects, join) run under an N-process
+launcher on one host (SURVEY.md §4).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from horovod_tpu.runner.http_server import RendezvousServer  # noqa: E402
+
+from test_multiprocess import ENGINES  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "torch_worker.py")
+
+
+def run_torch_workers(scenario, np_=2, timeout=180.0, engine="native"):
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+            })
+            if engine == "py" or (engine == "mixed" and rank % 2 == 1):
+                env["HVD_TPU_CORE"] = "py"
+            else:
+                env.pop("HVD_TPU_CORE", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + timeout
+        outs = []
+        for p in procs:
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(f"torch scenario {scenario} timed out")
+            outs.append((p.returncode, out.decode(), err.decode()))
+        for rank, (code, out, err) in enumerate(outs):
+            assert code == 0, (
+                f"torch scenario {scenario} rank {rank} failed "
+                f"(exit {code}):\n{out}\n{err}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+# -- single-process (size=1 identity semantics, autograd shapes) ----------
+
+
+@pytest.fixture
+def hvd1():
+    import horovod_tpu.torch as hvd
+
+    hvd.init(rank=0, size=1, local_rank=0, local_size=1)
+    yield hvd
+    hvd.shutdown()
+
+
+class TestSingleProcess:
+    def test_allreduce_identity(self, hvd1):
+        x = torch.arange(6, dtype=torch.float32)
+        out = hvd1.allreduce(x, op=hvd1.Sum, name="s.ar")
+        assert torch.equal(out, x)
+
+    def test_inplace_returns_same_tensor(self, hvd1):
+        x = torch.ones(3)
+        assert hvd1.allreduce_(x, name="s.arr") is x
+
+    def test_grad_flows(self, hvd1):
+        x = torch.ones(4, requires_grad=True)
+        out = hvd1.allreduce(x, op=hvd1.Sum, name="s.g")
+        out.sum().backward()
+        assert torch.allclose(x.grad, torch.ones(4))
+
+    def test_broadcast_object_roundtrip(self, hvd1):
+        assert hvd1.broadcast_object({"a": [1, 2]}, 0) == {"a": [1, 2]}
+
+    def test_distributed_optimizer_size1(self, hvd1):
+        model = torch.nn.Linear(3, 1)
+        opt = hvd1.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        loss = model(torch.ones(2, 3)).sum()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()  # must not hang with no hooks registered (size==1)
+
+    def test_duplicate_named_parameters_rejected(self, hvd1):
+        model = torch.nn.Linear(3, 1)
+        params = list(model.named_parameters())
+        dup = params + [params[0]]
+        with pytest.raises(ValueError, match="unique"):
+            hvd1.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=dup)
+
+    def test_missing_named_parameters_rejected(self, hvd1):
+        model = torch.nn.Linear(3, 1)
+        partial = list(model.named_parameters())[:-1]
+        with pytest.raises(ValueError, match="not named"):
+            hvd1.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=partial)
+
+    def test_alltoall_with_splits_size1(self, hvd1):
+        out, recv = hvd1.alltoall(torch.arange(4.0), splits=[4],
+                                  name="s.a2a")
+        assert torch.equal(out, torch.arange(4.0))
+        assert recv.tolist() == [4]
+
+
+# -- multi-process --------------------------------------------------------
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_torch_ops(engine):
+    run_torch_workers("ops", 2, engine=engine)
+
+
+def test_torch_ops_3proc():
+    run_torch_workers("ops", 3)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_torch_grads(engine):
+    run_torch_workers("grads", 2, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_torch_optimizer(engine):
+    run_torch_workers("optimizer", 2, engine=engine)
+
+
+def test_torch_optimizer_accumulate():
+    run_torch_workers("optimizer_accumulate", 2)
+
+
+def test_torch_join():
+    run_torch_workers("join", 3)
