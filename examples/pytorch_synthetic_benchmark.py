@@ -1,0 +1,163 @@
+"""ResNet-50 synthetic benchmark, PyTorch edition.
+
+Parity: ``examples/pytorch_synthetic_benchmark.py`` in the reference —
+same defaults (ResNet-50, batch 32, 10 warmup batches, 10 iters of 10
+batches), same ``--fp16-allreduce`` toggle, same img/sec ± CI output.
+The reference pulls the model from torchvision; this environment ships
+torch without torchvision, so an equivalent compact ResNet-50
+(bottleneck v1.5) is defined inline.  Run:
+
+    hvdrun -np 4 python examples/pytorch_synthetic_benchmark.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Runnable straight from a checkout: put the repo root on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+import horovod_tpu.torch as hvd
+
+
+def parse_args():
+    p = argparse.ArgumentParser(
+        description="PyTorch synthetic benchmark",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "tiny"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    return p.parse_args()
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, cin, width, stride=1):
+        super().__init__()
+        cout = width * self.expansion
+        self.conv1 = nn.Conv2d(cin, width, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        self.conv2 = nn.Conv2d(width, width, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(width)
+        self.conv3 = nn.Conv2d(width, cout, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(cout)
+        self.relu = nn.ReLU(inplace=True)
+        self.down = None
+        if stride != 1 or cin != cout:
+            self.down = nn.Sequential(
+                nn.Conv2d(cin, cout, 1, stride=stride, bias=False),
+                nn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        identity = self.down(x) if self.down is not None else x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return self.relu(out + identity)
+
+
+class ResNet50(nn.Module):
+    def __init__(self, num_classes=1000, width=64):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2d(3, width, 7, stride=2, padding=3, bias=False),
+            nn.BatchNorm2d(width), nn.ReLU(inplace=True),
+            nn.MaxPool2d(3, stride=2, padding=1))
+        cin = width
+        layers = []
+        for i, (blocks, w) in enumerate(
+                zip((3, 4, 6, 3), (width, 2 * width, 4 * width, 8 * width))):
+            for b in range(blocks):
+                stride = 2 if (i > 0 and b == 0) else 1
+                layers.append(Bottleneck(cin, w, stride))
+                cin = w * Bottleneck.expansion
+        self.layers = nn.Sequential(*layers)
+        self.head = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.layers(self.stem(x))
+        x = x.mean((2, 3))
+        return self.head(x)
+
+
+def main():
+    args = parse_args()
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, torch.get_num_threads() // size))
+
+    if args.model == "tiny":
+        model = ResNet50(num_classes=100, width=8)
+        img_size = 32
+    else:
+        model = ResNet50()
+        img_size = 224
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    compression = hvd.Compression.fp16 if args.fp16_allreduce \
+        else hvd.Compression.none
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, img_size, img_size)
+    target = torch.randint(0, 100 if args.model == "tiny" else 1000,
+                           (args.batch_size,))
+    loss_fn = nn.CrossEntropyLoss()
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    if rank == 0:
+        print(f"Model: {args.model}")
+        print(f"Batch size: {args.batch_size}")
+        print(f"Number of processes: {size}")
+        print("Running warmup...")
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    if rank == 0:
+        print("Running benchmark...")
+    img_secs = []
+    for x in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.time() - t0
+        img_sec = args.batch_size * args.num_batches_per_iter / dt
+        if rank == 0:
+            print(f"Iter #{x}: {img_sec:.1f} img/sec per process")
+        img_secs.append(img_sec)
+
+    # Output format parity: pytorch_synthetic_benchmark.py results block.
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    if rank == 0:
+        print(f"Img/sec per process: {img_sec_mean:.1f} "
+              f"+-{img_sec_conf:.1f}")
+        print(f"Total img/sec on {size} process(es): "
+              f"{size * img_sec_mean:.1f} +-{size * img_sec_conf:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

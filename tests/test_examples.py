@@ -1,0 +1,79 @@
+"""Smoke tests for examples/ under the launcher.
+
+Role parity: the reference CI smoke-runs every example under both
+launchers (.buildkite/gen-pipeline.sh:127-176); here each example runs
+tiny configurations through `hvdrun` (gloo-style spawn) and
+single-process.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def run_example(script, np_, extra_args=(), timeout=240):
+    pythonpath = REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=pythonpath.rstrip(os.pathsep))
+    if np_ == 1:
+        cmd = [sys.executable, os.path.join(EXAMPLES, script),
+               *extra_args]
+    else:
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.run",
+               "-np", str(np_), "--",
+               sys.executable, os.path.join(EXAMPLES, script), *extra_args]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"{script} (np={np_}) failed:\n{proc.stdout}\n{proc.stderr}")
+    return proc.stdout
+
+
+def test_jax_mnist_2proc():
+    out = run_example("jax_mnist.py", 2,
+                      ["--steps", "20", "--batch-size", "16"])
+    assert "loss" in out
+    assert "images/sec" in out
+
+
+def test_jax_synthetic_benchmark_single():
+    out = run_example(
+        "jax_synthetic_benchmark.py", 1,
+        ["--model", "tiny", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2"])
+    assert "Img/sec per device" in out
+    assert "Total img/sec" in out
+
+
+def test_jax_synthetic_benchmark_2proc_fp16():
+    out = run_example(
+        "jax_synthetic_benchmark.py", 2,
+        ["--model", "tiny", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--fp16-allreduce"])
+    assert "Total img/sec on 2 device(s)" in out
+
+
+def test_pytorch_mnist_2proc():
+    pytest.importorskip("torch")
+    out = run_example(
+        "pytorch_mnist.py", 2,
+        ["--epochs", "1", "--steps-per-epoch", "10", "--batch-size", "16"])
+    assert "loss" in out
+
+
+def test_pytorch_synthetic_benchmark_2proc():
+    pytest.importorskip("torch")
+    out = run_example(
+        "pytorch_synthetic_benchmark.py", 2,
+        ["--model", "tiny", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--fp16-allreduce"])
+    assert "Total img/sec on 2 process(es)" in out
