@@ -1,0 +1,66 @@
+"""JAX-native training-loop helpers mirroring the reference's Keras
+callbacks (SURVEY.md §2.4 callbacks row) for users writing raw
+JAX/optax loops:
+
+* ``metric_average`` — epoch-end metric averaging across ranks
+  (MetricAverageCallback).
+* ``warmup_schedule`` — LR warmup from the single-worker value to
+  size× over N steps (LearningRateWarmupCallback's recipe as an optax
+  schedule, composable with optax.join_schedules).
+* ``schedule_with_multipliers`` — epoch-ranged LR multipliers
+  (LearningRateScheduleCallback) as an optax schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.ops import eager as _eager
+
+
+def metric_average(value, name: str):
+    """Average a scalar (or array) metric across all ranks; returns a
+    float for scalars (parity: _keras/callbacks.py:46-84)."""
+    out = _eager.allreduce(np.asarray(value, np.float64),
+                           op=ReduceOp.AVERAGE, name=f"metric.{name}")
+    return float(out) if np.ndim(out) == 0 or np.size(out) == 1 else out
+
+
+def warmup_schedule(base_lr: float, warmup_steps: int,
+                    size: Optional[int] = None) -> Callable:
+    """optax schedule: lr(step) ramps base_lr → base_lr*size over
+    warmup_steps, then stays at base_lr*size (parity: the gradual
+    warmup of _keras/callbacks.py:162-200)."""
+    import jax.numpy as jnp
+
+    n = basics.size() if size is None else size
+
+    def schedule(step):
+        frac = jnp.minimum(step / max(1, warmup_steps), 1.0)
+        return base_lr * (frac * (n - 1) + 1)
+
+    return schedule
+
+
+def schedule_with_multipliers(
+        base_lr: float,
+        multipliers: Sequence[Tuple[int, float]],
+        steps_per_epoch: int) -> Callable:
+    """optax schedule from (start_epoch, multiplier) pairs — the classic
+    ImageNet staircase the reference's examples build from
+    LearningRateScheduleCallback stacks."""
+    import jax.numpy as jnp
+
+    starts = jnp.asarray([e * steps_per_epoch for e, _ in multipliers])
+    # multiplier 1.0 applies before the first boundary
+    mults = jnp.asarray([1.0] + [m for _, m in multipliers])
+
+    def schedule(step):
+        idx = jnp.clip(jnp.sum(step >= starts), 0, len(mults) - 1)
+        return base_lr * mults[idx]
+
+    return schedule

@@ -1,0 +1,125 @@
+"""MXNet front-end: ``import horovod_tpu.mxnet as hvd``.
+
+Role parity: ``horovod/mxnet/__init__.py`` — ``DistributedOptimizer``
+(gradient allreduce with rescale_grad /= size), gluon
+``DistributedTrainer``, and ``broadcast_parameters``.  MXNet is not
+shipped in this environment (the project reached end-of-life upstream);
+the module degrades to a clear ImportError at use time while keeping
+the surface importable for introspection.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+
+try:
+    import mxnet  # noqa: F401
+
+    _HAVE_MXNET = True
+except ImportError:
+    _HAVE_MXNET = False
+
+
+def _require_mxnet(what: str):
+    if not _HAVE_MXNET:
+        raise ImportError(
+            f"horovod_tpu.mxnet.{what} requires the `mxnet` package, "
+            "which is not installed in this environment. The eager "
+            "collective engine itself is framework-agnostic — see "
+            "horovod_tpu (JAX), horovod_tpu.torch, or "
+            "horovod_tpu.tensorflow for supported front-ends.")
+
+
+def DistributedOptimizer(optimizer, op=None):
+    """Parity: mxnet/__init__.py:40-69 — wraps an mxnet optimizer,
+    allreducing gradients with rescale_grad divided by world size."""
+    _require_mxnet("DistributedOptimizer")
+    from horovod_tpu.ops import eager
+    import numpy as np
+
+    class _DistributedOptimizer(optimizer.__class__):
+        def __init__(self, inner):
+            self.__dict__.update(inner.__dict__)
+            self.rescale_grad = getattr(inner, "rescale_grad", 1.0) / size()
+
+        def _do_allreduce(self, index, grad):
+            if size() == 1:
+                return
+            if isinstance(index, (tuple, list)):
+                for i in range(len(index)):
+                    out = eager.allreduce(grad[i].asnumpy(),
+                                          name=f"mx.grad.{index[i]}",
+                                          average=False)
+                    grad[i][:] = out
+            else:
+                out = eager.allreduce(grad.asnumpy(),
+                                      name=f"mx.grad.{index}",
+                                      average=False)
+                grad[:] = out
+
+        def update(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            super().update(index, weight, grad, state)
+
+        def update_multi_precision(self, index, weight, grad, state):
+            self._do_allreduce(index, grad)
+            super().update_multi_precision(index, weight, grad, state)
+
+    return _DistributedOptimizer(optimizer)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None):
+    """Parity: mxnet/__init__.py:87-108 gluon Trainer wrapper."""
+    _require_mxnet("DistributedTrainer")
+    import mxnet as mx
+    from horovod_tpu.ops import eager
+
+    class _DistributedTrainer(mx.gluon.Trainer):
+        def __init__(self):
+            param_list = params
+            if isinstance(param_list, dict):
+                param_list = [param_list[k] for k in sorted(param_list)]
+            super().__init__(param_list, optimizer,
+                             optimizer_params, kvstore=None)
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        out = eager.allreduce(g.asnumpy(),
+                                              name=f"mx.tr.{i}",
+                                              average=False)
+                        g[:] = out
+
+    return _DistributedTrainer()
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Parity: mxnet/__init__.py broadcast_parameters — works on gluon
+    ParameterDict or a plain dict of NDArrays."""
+    _require_mxnet("broadcast_parameters")
+    from horovod_tpu.ops import eager
+
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        try:
+            nd = p.data()
+        except AttributeError:
+            nd = p
+        out = eager.broadcast(nd.asnumpy(), root_rank=root_rank,
+                              name=f"mx.bp.{name}")
+        nd[:] = out

@@ -1,0 +1,272 @@
+"""TensorFlow 2 front-end: ``import horovod_tpu.tensorflow as hvd``.
+
+Role parity: ``horovod/tensorflow/__init__.py`` + ``tensorflow/mpi_ops.py``
+— allreduce/allgather/broadcast on tf tensors with gradient support,
+``broadcast_variables``, ``DistributedGradientTape``, and a Keras-3
+``DistributedOptimizer`` (the reference's TF custom ops become
+``tf.py_function`` bridges into the shared coordination engine: the op
+executes eagerly at graph runtime, so the same engine serves eager code
+and compiled ``tf.function`` graphs).
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+from horovod_tpu.basics import (  # noqa: F401
+    cache_stats,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    gloo_built,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+    xla_built,
+)
+from horovod_tpu import basics
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.ops import eager as _eager
+from horovod_tpu.ops.eager import _auto_name, _resolve_op
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class Compression:
+    """fp16-on-the-wire gradient compression (parity:
+    tensorflow/compression.py)."""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            if t.dtype.is_floating and t.dtype != tf.float16:
+                return tf.cast(t, tf.float16), t.dtype
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return tf.cast(t, ctx) if ctx is not None else t
+
+
+def _engine_call(fn, x, out_dtype):
+    """Run an engine collective on a tf tensor; works in eager mode and
+    inside tf.function (py_function escapes the graph at runtime, which
+    is exactly where the reference's AsyncOpKernel enqueued)."""
+    y = tf.py_function(lambda v: fn(v.numpy()), [x], out_dtype)
+    return y
+
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None, name=None):
+    """Differentiable allreduce of a tf.Tensor (or IndexedSlices, which
+    gather values+indices like the reference, tensorflow/__init__.py:74)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        # Sparse gradient path: allgather values and indices.
+        values = allgather(tensor.values, name=f"{name}.values"
+                           if name else None)
+        indices = allgather(tensor.indices, name=f"{name}.indices"
+                            if name else None)
+        rop = _resolve_op(op, average)
+        if rop == ReduceOp.AVERAGE:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    rop = _resolve_op(op, average)
+    nm = _auto_name("tf.allreduce", name)
+    compressed, ctx = compression.compress(tf.convert_to_tensor(tensor))
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _engine_call(
+            lambda v: _eager.allreduce(v, op=rop, name=nm), x, x.dtype)
+        y.set_shape(x.shape)
+
+        def grad(dy):
+            # Derived (trace-time) names keep every rank's runtime naming
+            # identical even when TF executes py_functions concurrently.
+            return allreduce(dy, op=rop, name=f"{nm}.grad")
+
+        return y, grad
+
+    return compression.decompress(_fn(compressed), ctx)
+
+
+def allgather(tensor, name=None):
+    """Differentiable allgather: concat along dim 0 (ragged first dims
+    allowed); backward reduces and extracts this rank's segment."""
+    nm = _auto_name("tf.allgather", name)
+    x = tf.convert_to_tensor(tensor)
+    dim0 = tf.shape(x)[0]
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _engine_call(lambda v: _eager.allgather(v, name=nm), x, x.dtype)
+        y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
+
+        def grad(dy):
+            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            sizes = _engine_call(
+                lambda v: _eager.allgather(v, name=f"{nm}.grad.sizes"),
+                tf.reshape(dim0, [1]), tf.int32)
+            offset = tf.reduce_sum(sizes[:rank()])
+            return reduced[offset:offset + dim0]
+
+        return y, grad
+
+    return _fn(x)
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    """Differentiable broadcast from root; backward sums to root."""
+    nm = _auto_name("tf.broadcast", name)
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _engine_call(
+            lambda v: _eager.broadcast(v, root_rank=root_rank, name=nm),
+            x, x.dtype)
+        y.set_shape(x.shape)
+
+        def grad(dy):
+            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            if rank() == root_rank:
+                return reduced
+            return reduced * 0
+
+        return y, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
+
+
+def alltoall(tensor, splits=None, name=None):
+    nm = _auto_name("tf.alltoall", name)
+    x = tf.convert_to_tensor(tensor)
+    if splits is None:
+        return _engine_call(lambda v: _eager.alltoall(v, name=nm),
+                            x, x.dtype)
+    sp = [int(s) for s in splits]
+    data, recv = tf.py_function(
+        lambda v: _eager.alltoall(v.numpy(), splits=sp, name=nm),
+        [x], [x.dtype, tf.int64])
+    return data, recv
+
+
+def join():
+    return basics._engine().join()
+
+
+def barrier():
+    basics._engine().barrier()
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    return _eager.broadcast_object(obj, root_rank, name)
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assigns every variable the root's value (parity:
+    tensorflow/__init__.py:139 broadcast_variables)."""
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v, root_rank, name=f"bv.{i}"))
+
+
+class DistributedGradientTape:
+    """Wraps a ``tf.GradientTape`` so ``gradient()`` allreduces the
+    results (parity: tensorflow/__init__.py:474-531 — same wrap-an-
+    existing-tape contract: ``tape = hvd.DistributedGradientTape(tape)``).
+    Can also be used directly as a context manager, in which case it
+    owns a fresh tape."""
+
+    def __init__(self, gradtape=None, device_dense="", device_sparse="",
+                 compression=Compression.none, op=ReduceOp.AVERAGE,
+                 persistent=False, watch_accessed_variables=True):
+        self._tape = gradtape if gradtape is not None else tf.GradientTape(
+            persistent=persistent,
+            watch_accessed_variables=watch_accessed_variables)
+        self._compression = compression
+        self._op = op
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        # watch, watched_variables, jacobian, ... delegate to the tape
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        single = not isinstance(grads, (list, tuple))
+        if single:
+            grads = [grads]
+        reduced = [
+            allreduce(g, op=self._op, compression=self._compression,
+                      name=f"dgt.{i}") if g is not None else None
+            for i, g in enumerate(grads)]
+        return reduced[0] if single else reduced
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=Compression.none,
+                         op=ReduceOp.AVERAGE,
+                         backward_passes_per_step=1):
+    """Wraps a Keras-3 optimizer: gradients are allreduced before being
+    applied (parity: tensorflow/__init__.py:266-311 — there via
+    compute_gradients; Keras 3 funnels through apply_gradients).
+
+    The instance is re-classed in place (same dynamic-subclass technique
+    as the reference) so restored slot state and the iteration counter
+    survive — important when wrapping an optimizer loaded from a
+    checkpoint."""
+    if backward_passes_per_step != 1:
+        raise NotImplementedError(
+            "backward_passes_per_step > 1 is not supported by the "
+            "TensorFlow front-end yet; accumulate gradients in the "
+            "training loop, or use horovod_tpu.torch which implements "
+            "it natively.")
+    base_cls = optimizer.__class__
+    _op = op
+    _compression = compression
+
+    class _Wrapped(base_cls):
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            tvars = [v for _, v in grads_and_vars]
+            reduced = [
+                allreduce(g, op=_op, compression=_compression,
+                          name=f"do.{i}") if g is not None else None
+                for i, g in enumerate(grads)]
+            return super().apply_gradients(
+                zip(reduced, tvars), *args, **kwargs)
+
+    _Wrapped.__name__ = f"Distributed{base_cls.__name__}"
+    optimizer.__class__ = _Wrapped
+    return optimizer

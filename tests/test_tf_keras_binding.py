@@ -1,0 +1,167 @@
+"""TensorFlow + Keras binding tests, single- and multi-process.
+
+Role parity: ``test/test_tensorflow.py`` (op matrix, gradient
+correctness, compression) + ``test/test_keras.py`` /
+``test_tensorflow2_keras.py`` (DistributedOptimizer, callbacks) run
+under an N-process launcher (SURVEY.md §4); plus the JAX-native
+callback-equivalents and the gated MXNet surface.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from horovod_tpu.runner.http_server import RendezvousServer  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "tf_worker.py")
+
+
+def run_tf_workers(scenario, np_=2, timeout=240.0):
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(f"tf scenario {scenario} timed out")
+        outs = [(p.returncode, *p.communicate()) for p in procs]
+        for rank, (code, out, err) in enumerate(outs):
+            assert code == 0, (
+                f"tf scenario {scenario} rank {rank} failed "
+                f"(exit {code}):\n{err.decode()[-4000:]}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def test_tf_ops():
+    run_tf_workers("ops", 2)
+
+
+def test_tf_graph_mode():
+    run_tf_workers("graph_mode", 2)
+
+
+def test_tf_tape():
+    run_tf_workers("tape", 2)
+
+
+def test_keras_fit():
+    run_tf_workers("keras_fit", 2)
+
+
+# -- single-process: LR callbacks + JAX-native schedules ------------------
+
+
+@pytest.fixture
+def hvd1():
+    import horovod_tpu.keras as hvd_keras
+
+    hvd_keras.init(rank=0, size=1, local_rank=0, local_size=1)
+    yield hvd_keras
+    hvd_keras.shutdown()
+
+
+def _tiny_model(lr=0.1):
+    import keras
+
+    model = keras.Sequential([keras.layers.Input((4,)),
+                              keras.layers.Dense(1)])
+    model.compile(optimizer=keras.optimizers.SGD(learning_rate=lr),
+                  loss="mse", run_eagerly=True)
+    return model
+
+
+class TestLRCallbacks:
+    def test_warmup_reaches_size_times_lr(self, hvd1):
+        import horovod_tpu.keras.callbacks as C
+
+        model = _tiny_model(lr=0.1)
+        # size() == 1 → multiplier is identity; pin the internal math by
+        # faking a bigger world through the schedule formula instead.
+        cb = C.LearningRateWarmupCallback(warmup_epochs=2,
+                                          steps_per_epoch=4)
+        X = np.random.rand(16, 4).astype(np.float32)
+        y = np.random.rand(16, 1).astype(np.float32)
+        model.fit(X, y, batch_size=4, epochs=3, verbose=0, callbacks=[cb])
+        # with size 1 the lr must end where it began
+        np.testing.assert_allclose(
+            float(np.asarray(model.optimizer.learning_rate)), 0.1,
+            rtol=1e-5)
+
+    def test_schedule_staircase_multiplier(self, hvd1):
+        import horovod_tpu.keras.callbacks as C
+
+        model = _tiny_model(lr=0.1)
+        cb = C.LearningRateScheduleCallback(
+            multiplier=0.1, start_epoch=1, staircase=True)
+        X = np.random.rand(8, 4).astype(np.float32)
+        y = np.random.rand(8, 1).astype(np.float32)
+        hist = model.fit(X, y, batch_size=4, epochs=2, verbose=0,
+                         callbacks=[cb])
+        np.testing.assert_allclose(hist.history["lr"][0], 0.1, rtol=1e-5)
+        np.testing.assert_allclose(hist.history["lr"][1], 0.01, rtol=1e-5)
+
+
+class TestJaxNativeCallbacks:
+    def test_warmup_schedule(self, hvd1):
+        from horovod_tpu.callbacks import warmup_schedule
+
+        sched = warmup_schedule(0.1, warmup_steps=10, size=8)
+        assert float(sched(0)) == pytest.approx(0.1)
+        assert float(sched(5)) == pytest.approx(0.1 * 4.5)
+        assert float(sched(10)) == pytest.approx(0.8)
+        assert float(sched(100)) == pytest.approx(0.8)
+
+    def test_schedule_with_multipliers(self, hvd1):
+        from horovod_tpu.callbacks import schedule_with_multipliers
+
+        sched = schedule_with_multipliers(
+            0.4, [(0, 1.0), (2, 0.1), (4, 0.01)], steps_per_epoch=10)
+        assert float(sched(0)) == pytest.approx(0.4)
+        assert float(sched(19)) == pytest.approx(0.4)
+        assert float(sched(20)) == pytest.approx(0.04)
+        assert float(sched(45)) == pytest.approx(0.004)
+
+    def test_metric_average_size1(self, hvd1):
+        from horovod_tpu.callbacks import metric_average
+
+        assert metric_average(3.5, "loss") == pytest.approx(3.5)
+
+
+def test_mxnet_gated_surface():
+    import horovod_tpu.mxnet as hvd_mx
+
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.DistributedOptimizer(object())
+    with pytest.raises(ImportError, match="mxnet"):
+        hvd_mx.broadcast_parameters({})

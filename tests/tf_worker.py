@@ -1,0 +1,138 @@
+"""Worker script for multi-process TensorFlow/Keras binding tests."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def scenario_ops():
+    rank, size = hvd.rank(), hvd.size()
+    # allreduce dtypes
+    for dtype in (tf.float32, tf.float64, tf.int32, tf.int64):
+        x = tf.cast(tf.range(17), dtype) * (rank + 1)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"tf.ar.{dtype.name}")
+        expect = tf.cast(tf.range(17), dtype) * sum(
+            r + 1 for r in range(size))
+        assert out.dtype == dtype
+        np.testing.assert_allclose(out.numpy(), expect.numpy())
+    # average + fp16 compression
+    x = tf.fill([4, 3], float(rank))
+    out = hvd.allreduce(x, op=hvd.Average, name="tf.avg",
+                        compression=hvd.Compression.fp16)
+    np.testing.assert_allclose(out.numpy(),
+                               np.full((4, 3), (size - 1) / 2.0))
+    # allgather ragged
+    x = tf.fill([rank + 1, 2], float(rank))
+    out = hvd.allgather(x, name="tf.ag")
+    expect = np.concatenate(
+        [np.full((r + 1, 2), float(r), np.float32) for r in range(size)])
+    np.testing.assert_allclose(out.numpy(), expect)
+    # broadcast
+    for root in range(size):
+        x = tf.fill([3], float(rank + 1))
+        out = hvd.broadcast(x, root_rank=root, name=f"tf.bc.{root}")
+        np.testing.assert_allclose(out.numpy(), np.full(3, root + 1.0))
+    # broadcast_variables
+    v = tf.Variable(tf.fill([2, 2], float(rank)))
+    hvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), np.zeros((2, 2)))
+    # broadcast_object
+    obj = hvd.broadcast_object({"a": rank} if rank == 0 else None, 0)
+    assert obj == {"a": 0}
+
+
+def scenario_graph_mode():
+    # Collectives traced inside tf.function: py_function defers the
+    # engine call to graph runtime.
+    rank, size = hvd.rank(), hvd.size()
+
+    @tf.function
+    def step(x):
+        y = hvd.allreduce(x, op=hvd.Sum, name="tfg.ar")
+        return y * 2.0
+
+    for i in range(3):  # multiple executions of one trace reuse the name
+        out = step(tf.fill([8], float(rank + 1 + i)))
+        expect = np.full(8, 2.0 * sum(r + 1 + i for r in range(size)))
+        np.testing.assert_allclose(out.numpy(), expect)
+
+
+def scenario_tape():
+    rank, size = hvd.rank(), hvd.size()
+    w = tf.Variable(tf.ones([4]))
+    with hvd.DistributedGradientTape() as tape:
+        loss = tf.reduce_sum(w * (rank + 1.0))
+    (grad,) = tape.gradient(loss, [w])
+    expect = np.full(4, np.mean([r + 1.0 for r in range(size)]))
+    np.testing.assert_allclose(grad.numpy(), expect)
+    # wrap-an-existing-tape contract
+    with tf.GradientTape() as inner:
+        loss = tf.reduce_sum(w * (rank + 1.0))
+    tape = hvd.DistributedGradientTape(inner)
+    (grad,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(grad.numpy(), expect)
+
+
+def scenario_keras_fit():
+    import keras
+
+    import horovod_tpu.keras as hvd_keras
+
+    rank, size = hvd.rank(), hvd.size()
+    keras.utils.set_random_seed(100 + rank)  # divergent init on purpose
+
+    model = keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(1),
+    ])
+    opt = hvd_keras.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.05))
+    model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(128, 10).astype(np.float32)
+    w = rng.randn(10, 1).astype(np.float32)
+    y = X @ w
+    shard = slice(rank * 128 // size, (rank + 1) * 128 // size)
+
+    hist = model.fit(
+        X[shard], y[shard], batch_size=16, epochs=3, verbose=0,
+        callbacks=[
+            hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd_keras.callbacks.MetricAverageCallback(),
+        ])
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], losses
+    # weights in sync across ranks after training
+    flat = np.concatenate([w.reshape(-1) for w in model.get_weights()])
+    gathered = hvd.allgather(
+        tf.convert_to_tensor(flat.reshape(1, -1)), name="kf.check")
+    for r in range(size):
+        np.testing.assert_allclose(gathered.numpy()[r], flat, atol=1e-5)
+
+
+SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
+             if k.startswith("scenario_")}
+
+
+def main():
+    name = sys.argv[1]
+    hvd.init()
+    try:
+        SCENARIOS[name]()
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
