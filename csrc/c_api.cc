@@ -41,7 +41,8 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
                int64_t cache_capacity, int autotune, int tune_fusion,
                int tune_cycle, int tune_cache, int autotune_warmup,
                int autotune_max_samples, double autotune_sample_duration_s,
-               const char* autotune_log) {
+               const char* autotune_log, const char* timeline_path,
+               int timeline_mark_cycles) {
   if (g_engine) {
     g_last_error = "engine already initialized";
     return -1;
@@ -73,6 +74,8 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
     o.sample_duration_s = autotune_sample_duration_s;
     if (autotune_log) o.log_path = autotune_log;
   }
+  if (timeline_path) cfg.timeline_path = timeline_path;
+  cfg.timeline_mark_cycles = timeline_mark_cycles != 0;
   std::vector<int> data(data_fds, data_fds + size);
   std::vector<int> ctrl(ctrl_fds, ctrl_fds + size);
   try {

@@ -263,6 +263,8 @@ Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
     if (fd >= 0) SetNoDelay(fd);
   last_stall_check_s_ = NowS();
   cache_.SetCapacity(cfg.cache_capacity);
+  if (cfg.rank == 0 && !cfg.timeline_path.empty())
+    timeline_.Initialize(cfg.timeline_path, cfg.timeline_mark_cycles);
   if (cfg.autotune && cfg.rank == 0)
     pm_ = std::make_unique<ParameterManager>(
         TunedParams{cfg.fusion_threshold, cfg.cycle_time_s,
@@ -280,6 +282,7 @@ void Engine::Shutdown() {
     return;
   }
   if (bg_.joinable()) bg_.join();
+  timeline_.Shutdown();
   for (int fd : data_fds_)
     if (fd >= 0) ::close(fd);
   for (int fd : ctrl_fds_)
@@ -460,6 +463,7 @@ void Engine::BackgroundLoop() {
   try {
     while (!shutdown_.load()) {
       double t0 = NowS();
+      timeline_.MarkCycleStart();
       if (!RunLoopOnce()) break;
       double dt = NowS() - t0;
       if (dt < cfg_.cycle_time_s) {
@@ -618,6 +622,11 @@ void Engine::AbsorbRequest(const Request& req,
     }
     return;
   }
+  if (timeline_.enabled()) {
+    if (req.request_rank == 0)
+      timeline_.NegotiateStart(req.tensor_name, OpName(req.request_type));
+    timeline_.NegotiateRankReady(req.tensor_name, req.request_rank);
+  }
   auto& ent = msg_table_[req.tensor_name];
   if (ent.requests.empty()) ent.first_seen_s = NowS();
   ent.requests.push_back(req);
@@ -679,6 +688,7 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
     if (it == msg_table_.end()) continue;
     auto reqs = std::move(it->second.requests);
     msg_table_.erase(it);
+    timeline_.NegotiateEnd(name);
     std::set<int> hit_ranks;
     auto hit = hit_ranks_.find(name);
     if (hit != hit_ranks_.end()) {
@@ -1032,6 +1042,9 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
   }
 
   auto entries = GetEntries(resp);
+  if (timeline_.enabled() && !resp.tensor_names.empty())
+    timeline_.Start(resp.tensor_names[0],
+                    OpName(static_cast<RequestType>(resp.response_type)));
   Status status = Status::OK();
   try {
     switch (resp.response_type) {
@@ -1053,6 +1066,8 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
           ReleaseName(e.name);
           if (e.handle >= 0) handles_.MarkDone(e.handle, Status::OK());
         }
+        if (timeline_.enabled() && !resp.tensor_names.empty())
+          timeline_.End(resp.tensor_names[0]);
         return;
       default:
         throw std::runtime_error("bad response type");
@@ -1069,6 +1084,8 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
     // Data-plane failure leaves sockets in an undefined protocol state.
     Abort(ex.what());
   }
+  if (timeline_.enabled() && !resp.tensor_names.empty())
+    timeline_.End(resp.tensor_names[0]);
 }
 
 void Engine::DoAllreduce(std::vector<TensorTableEntry>& entries,

@@ -37,6 +37,7 @@
 #include <vector>
 
 #include "autotune.h"
+#include "timeline.h"
 #include "types.h"
 #include "wire.h"
 
@@ -96,6 +97,9 @@ struct EngineConfig {
   // Autotuner (coordinator only; parity: parameter_manager.cc).
   bool autotune = false;
   ParameterManager::Options autotune_opts;
+  // Timeline (rank 0 only; parity: timeline.cc, HOROVOD_TIMELINE).
+  std::string timeline_path;
+  bool timeline_mark_cycles = false;
 };
 
 // LRU cache of previously negotiated single-tensor ALLREDUCE responses,
@@ -265,6 +269,9 @@ class Engine {
   bool have_pending_params_ = false;
   TunedParams pending_params_;
   void ApplyParams(const WireParams& p);
+
+  // Timeline (rank 0 only; events emitted from the background thread).
+  Timeline timeline_;
 
   // Fusion scratch (parity: fusion_buffer_manager.cc — one lazily grown
   // persistent buffer reused across fused launches).

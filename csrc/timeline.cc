@@ -1,0 +1,112 @@
+#include "timeline.h"
+
+#include <algorithm>
+#include <cinttypes>
+
+namespace hvd {
+
+Timeline::~Timeline() { Shutdown(); }
+
+void Timeline::Initialize(const std::string& path, bool mark_cycles) {
+  if (enabled_ || path.empty()) return;
+  f_ = std::fopen(path.c_str(), "w");
+  if (!f_) return;
+  std::fprintf(f_, "[\n");
+  start_ = std::chrono::steady_clock::now();
+  mark_cycles_ = mark_cycles;
+  enabled_ = true;
+  writer_ = std::thread([this] { WriterLoop(); });
+}
+
+void Timeline::Shutdown() {
+  if (!enabled_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  std::fclose(f_);
+  f_ = nullptr;
+  enabled_ = false;
+}
+
+int Timeline::Tid(const std::string& tensor) {
+  if (tensor.empty()) return 0;
+  auto it = tensor_tids_.find(tensor);
+  if (it != tensor_tids_.end()) return it->second;
+  int tid = static_cast<int>(tensor_tids_.size()) + 1;
+  tensor_tids_[tensor] = tid;
+  return tid;
+}
+
+void Timeline::Emit(char ph, const std::string& name,
+                    const std::string& tensor) {
+  if (!enabled_) return;
+  auto us = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now() - start_)
+                .count() /
+            1e3;
+  char buf[512];
+  int n;
+  if (name.empty()) {
+    n = std::snprintf(buf, sizeof(buf),
+                      "{\"ph\": \"%c\", \"ts\": %.3f, \"pid\": 0, "
+                      "\"tid\": %d},\n",
+                      ph, us, Tid(tensor));
+  } else {
+    n = std::snprintf(buf, sizeof(buf),
+                      "{\"ph\": \"%c\", \"ts\": %.3f, \"pid\": 0, "
+                      "\"tid\": %d, \"name\": \"%s\"},\n",
+                      ph, us, Tid(tensor), name.c_str());
+  }
+  if (n <= 0) return;
+  // snprintf returns the would-have-been length on truncation.
+  size_t len = std::min(static_cast<size_t>(n), sizeof(buf) - 1);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.emplace_back(buf, len);
+  }
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateStart(const std::string& tensor,
+                              const char* op_name) {
+  Emit('B', std::string("NEGOTIATE_") + op_name, tensor);
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
+  Emit('i', "RANK_" + std::to_string(rank) + "_READY", tensor);
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor) {
+  Emit('E', "", tensor);
+}
+
+void Timeline::Start(const std::string& tensor, const char* op_name) {
+  Emit('B', op_name, tensor);
+}
+
+void Timeline::End(const std::string& tensor) { Emit('E', "", tensor); }
+
+void Timeline::MarkCycleStart() {
+  if (mark_cycles_) Emit('i', "CYCLE_START", "");
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  for (;;) {
+    cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+    while (!queue_.empty()) {
+      std::string ev = std::move(queue_.front());
+      queue_.pop_front();
+      lk.unlock();
+      std::fwrite(ev.data(), 1, ev.size(), f_);
+      std::fflush(f_);
+      lk.lock();
+    }
+    if (stop_) return;
+  }
+}
+
+}  // namespace hvd

@@ -26,9 +26,9 @@ _CSRC_DIR = _PKG_DIR.parent / "csrc"
 _lib: Optional[ctypes.CDLL] = None
 
 _SOURCES = ("wire.cc", "sockets.cc", "kernels.cc", "autotune.cc",
-            "engine.cc", "c_api.cc")
+            "timeline.cc", "engine.cc", "c_api.cc")
 _HEADERS = ("types.h", "wire.h", "sockets.h", "kernels.h", "autotune.h",
-            "engine.h")
+            "timeline.h", "engine.h")
 
 
 class NativeUnavailable(ImportError):
@@ -79,7 +79,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_int32), c.POINTER(c.c_int32),
         c.c_double, c.c_int64, c.c_double, c.c_double, c.c_int, c.c_int64,
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
-        c.c_char_p,
+        c.c_char_p, c.c_char_p, c.c_int,
     ]
     lib.hvd_create.restype = c.c_int
     lib.hvd_cache_stats.argtypes = [c.POINTER(c.c_int64)]

@@ -62,7 +62,9 @@ class NativeEngine:
         self.cross_size = cross_size
         self.is_homogeneous = True
         self.native_fallback_reason = None
-        self.timeline = timeline_mod.from_env(rank)
+        # The native core writes the timeline itself (csrc/timeline.cc);
+        # a Python writer here would clobber the same file.
+        self.timeline = timeline_mod.Timeline()
 
         data, ctrl_sock, ctrl_socks = bootstrap_mesh(
             rank, size, rdv_addr, rdv_port)
@@ -88,7 +90,11 @@ class NativeEngine:
             1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
             else 0,
             env_util.get_int(env_util.CACHE_CAPACITY, 1024),
-            *self._autotune_args())
+            *self._autotune_args(),
+            (env_util.get_str(env_util.TIMELINE).encode() or None)
+            if rank == 0 else None,
+            1 if env_util.get_bool(env_util.TIMELINE_MARK_CYCLES, False)
+            else 0)
         if rc != 0:
             raise OSError(self._lib.hvd_last_error().decode())
 

@@ -244,6 +244,31 @@ def scenario_cache_eviction():
     assert stats["evictions"] > 0, stats
 
 
+def scenario_stall():
+    # Parity: test/test_stall.py — rank skew beyond the stall threshold
+    # makes the coordinator warn ("Stalled tensor ...") and, past the
+    # shutdown threshold, terminate the job; pending collectives get a
+    # shutdown error instead of hanging forever.
+    import time
+
+    rank = hvd.rank()
+    if rank == 0:
+        try:
+            hvd.allreduce(np.ones(4, np.float32), name="stall.t",
+                          op=hvd.Sum)
+        except RuntimeError as e:
+            assert "shut down" in str(e).lower(), e
+            return
+        raise AssertionError("expected stall shutdown error")
+    else:
+        time.sleep(6)  # past HVD_STALL_SHUTDOWN_TIME_SECONDS
+        try:
+            hvd.allreduce(np.ones(4, np.float32), name="stall.t",
+                          op=hvd.Sum)
+        except RuntimeError:
+            pass  # engine already shut down — expected
+
+
 def scenario_autotune():
     # Enough steady-state traffic for the tuner (tiny sample windows set
     # by the test) to warm up, take its samples, and settle — while every

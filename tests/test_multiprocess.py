@@ -124,18 +124,34 @@ def test_error_mismatch(engine):
     run_workers("error_mismatch", 2, engine=engine)
 
 
-def test_timeline(tmp_path):
-    # The timeline writer lives in the Python engine (the native core
-    # does not emit traces yet).
-    path = str(tmp_path / "timeline.json")
-    run_workers("timeline", 2, extra_env={"HVD_TIMELINE": path},
-                engine="py")
-    # Parity: test/test_timeline.py:31-57 — the trace must contain the
+@pytest.mark.parametrize("engine", ENGINES)
+def test_stall_detection_and_shutdown(engine):
+    # Parity: test/test_stall.py wired via HOROVOD_STALL_* env
+    # (gen-pipeline.sh:155) — warn after 1s, hard shutdown after 2s.
+    outs = run_workers("stall", 2, engine=engine, timeout=60.0,
+                       extra_env={
+                           "HVD_STALL_CHECK_TIME_SECONDS": "1",
+                           "HVD_STALL_SHUTDOWN_TIME_SECONDS": "2",
+                       })
+    rank0_err = outs[0][2]
+    assert "Stalled tensor" in rank0_err, rank0_err[-2000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_timeline(tmp_path, engine):
+    # Both engines write the same Chrome-tracing format (rank 0 only);
+    # parity: test/test_timeline.py:31-57 — the trace must contain the
     # negotiation and op phases.
+    path = str(tmp_path / f"timeline_{engine}.json")
+    run_workers("timeline", 2,
+                extra_env={"HVD_TIMELINE": path,
+                           "HVD_TIMELINE_MARK_CYCLES": "1"},
+                engine=engine)
     with open(path) as f:
         content = f.read()
     assert "NEGOTIATE_ALLREDUCE" in content
     assert '"ALLREDUCE"' in content
+    assert "CYCLE_START" in content
     # valid JSON events (strip trailing comma, close the array)
     events = json.loads(content.rstrip().rstrip(",") + "]")
     assert len(events) > 0

@@ -161,3 +161,8 @@ def test_torch_optimizer_accumulate():
 
 def test_torch_join():
     run_torch_workers("join", 3)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_torch_adasum_golden(engine):
+    run_torch_workers("adasum", 4, engine=engine)

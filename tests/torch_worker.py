@@ -170,6 +170,20 @@ def scenario_optimizer_accumulate():
         assert torch.allclose(gathered[r], flat, atol=1e-6)
 
 
+def scenario_adasum():
+    # Golden-numerics parity: test/test_adasum_pytorch.py — torch-side
+    # Adasum allreduce must match the numpy reference model.
+    from horovod_tpu.ops.adasum import adasum_reduce_numpy
+
+    rank, size = hvd.rank(), hvd.size()
+    rng = np.random.RandomState(7)
+    all_grads = [rng.randn(53).astype(np.float32) for _ in range(size)]
+    out = hvd.allreduce(torch.from_numpy(all_grads[rank]), op=hvd.Adasum,
+                        name="t.adasum")
+    expect = adasum_reduce_numpy(all_grads)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+
 def scenario_join():
     rank, size = hvd.rank(), hvd.size()
     for b in range(rank + 1):
