@@ -14,6 +14,7 @@ C++ core afterwards (``csrc/engine.h``), which owns them from then on.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
@@ -44,15 +45,20 @@ def bootstrap_mesh(
     kv = KVClient(rdv_addr, rdv_port)
     listener = su.listen_on()
     port = listener.getsockname()[1]
+    # Optional key namespace so re-launched gangs (e.g. a retried Spark
+    # barrier stage) never rendezvous against a previous attempt's stale
+    # addresses on a still-running server.
+    scope = os.environ.get("HVD_RDV_SCOPE", "")
+    prefix = f"hvd/{scope}/" if scope else "hvd/"
     # Learn the address peers can reach us at from the route the rendezvous
     # connection takes (works multi-host without NIC configuration).
     my_host = kv.local_address() or "127.0.0.1"
-    kv.put(f"hvd/addr/{rank}", f"{my_host}:{port}")
+    kv.put(f"{prefix}addr/{rank}", f"{my_host}:{port}")
     peers = {}
     for i in range(size):
         if i == rank:
             continue
-        v = kv.wait_get(f"hvd/addr/{i}", timeout=start_timeout)
+        v = kv.wait_get(f"{prefix}addr/{i}", timeout=start_timeout)
         host, p = v.rsplit(":", 1)
         peers[i] = (host, int(p))
 

@@ -1,0 +1,165 @@
+"""Spark integration: ``horovod_tpu.spark.run(fn, ...)``.
+
+Role parity: ``horovod/spark/__init__.py`` — run a training function in
+``num_proc`` Spark tasks, with rank/local-rank assignment, a rendezvous
+back to the driver, and per-rank results returned to the caller.  The
+reference tunnels mpirun through Spark task services
+(``spark/__init__.py:39-72`` + ``driver/mpirun_rsh.py``); Spark 2.4+
+barrier execution mode makes that machinery unnecessary — the tasks
+rendezvous against the driver's HTTP server exactly like `hvdrun`
+workers do, so the whole coordination stack is shared with the plain
+launcher.
+
+Gated on the ``pyspark`` package (not shipped in this environment); the
+Estimator API (``horovod/spark/common/estimator.py``) additionally needs
+``petastorm`` for DataFrame materialization and raises accordingly.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Callable, List, Optional
+
+try:
+    import pyspark  # noqa: F401
+
+    _HAVE_PYSPARK = True
+except ImportError:
+    _HAVE_PYSPARK = False
+
+
+def _require_pyspark(what: str):
+    if not _HAVE_PYSPARK:
+        raise ImportError(
+            f"horovod_tpu.spark.{what} requires the `pyspark` package, "
+            "which is not installed in this environment. For multi-"
+            "process launching without Spark, use the `hvdrun` launcher "
+            "or the programmatic horovod_tpu.runner.run.run() API.")
+
+
+def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
+        start_timeout: float = 600.0, env=None,
+        verbose: int = 1) -> List[Any]:
+    """Runs ``fn(*args, **kwargs)`` on ``num_proc`` Spark tasks as one
+    Horovod job; returns the per-rank results ordered by rank (parity:
+    horovod/spark/__init__.py:104 run()).
+
+    Requires the cluster to support barrier execution mode (Spark 2.4+),
+    which guarantees gang scheduling — all ranks run concurrently, the
+    property the reference builds its own task-service machinery for.
+    Task stdout/stderr go to Spark's executor logs.
+    """
+    _require_pyspark("run")
+    kwargs = kwargs or {}
+    extra_env = dict(env or {})
+    extra_env.setdefault("HVD_START_TIMEOUT", str(start_timeout))
+
+    from pyspark import BarrierTaskContext
+    from pyspark.sql import SparkSession
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.runner.run import _routable_address
+
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    if num_proc is None:
+        num_proc = max(1, sc.defaultParallelism)
+
+    # Prefer the address Spark already knows executors can reach the
+    # driver at; fall back to default-route discovery (hostname
+    # resolution often yields loopback on Debian-style /etc/hosts).
+    addr = sc.getConf().get("spark.driver.host", None) or \
+        _routable_address()
+    server = RendezvousServer(addr)
+    port = server.start()
+    nproc = num_proc
+    if verbose:
+        print(f"horovod_tpu.spark: launching {nproc} barrier tasks, "
+              f"rendezvous at {addr}:{port}")
+
+    def _task(_iterator):
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        # Slot assignment from the gang's host placement, mirroring the
+        # launcher (runner/hosts.py): hosts ordered by first appearance;
+        # the cross "axis" at local index L spans the hosts that have a
+        # local rank L.
+        hosts = [info.address.split(":")[0]
+                 for info in ctx.getTaskInfos()]
+        by_host = OrderedDict()
+        for r, h in enumerate(hosts):
+            by_host.setdefault(h, []).append(r)
+        my_host = hosts[rank]
+        local_rank = by_host[my_host].index(rank)
+        cross_hosts = [h for h, rs in by_host.items()
+                       if len(rs) > local_rank]
+
+        task_env = {
+            "HVD_RANK": str(rank),
+            "HVD_SIZE": str(nproc),
+            "HVD_LOCAL_RANK": str(local_rank),
+            "HVD_LOCAL_SIZE": str(len(by_host[my_host])),
+            "HVD_CROSS_RANK": str(cross_hosts.index(my_host)),
+            "HVD_CROSS_SIZE": str(len(cross_hosts)),
+            "HVD_RENDEZVOUS_ADDR": addr,
+            "HVD_RENDEZVOUS_PORT": str(port),
+            # Stage retries must not rendezvous against a previous
+            # attempt's stale addresses on the still-running server.
+            "HVD_RDV_SCOPE": f"attempt{ctx.stageAttemptNumber()}",
+        }
+        task_env.update(extra_env)
+        # Snapshot + restore: PySpark reuses worker processes, and stale
+        # HVD_* would hijack a later unrelated hvd.init() in this app.
+        saved = {k: os.environ.get(k) for k in task_env}
+        os.environ.update(task_env)
+
+        import horovod_tpu as hvd
+
+        try:
+            hvd.init()
+            try:
+                result = fn(*args, **kwargs)
+            finally:
+                hvd.shutdown()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        yield rank, result
+
+    try:
+        pairs = (sc.parallelize(range(nproc), nproc)
+                 .barrier()
+                 .mapPartitions(_task)
+                 .collect())
+    finally:
+        server.stop()
+    return [result for _, result in sorted(pairs)]
+
+
+class KerasEstimator:
+    """Parity surface: horovod/spark/keras/estimator.py — fit a Keras
+    model on a Spark DataFrame.  Needs pyspark + petastorm."""
+
+    def __init__(self, *a, **kw):
+        _require_pyspark("KerasEstimator")
+        raise NotImplementedError(
+            "KerasEstimator needs petastorm-based DataFrame "
+            "materialization, which is not available in this "
+            "environment; materialize your data and call "
+            "horovod_tpu.spark.run(train_fn) instead.")
+
+
+class TorchEstimator:
+    """Parity surface: horovod/spark/torch/estimator.py."""
+
+    def __init__(self, *a, **kw):
+        _require_pyspark("TorchEstimator")
+        raise NotImplementedError(
+            "TorchEstimator needs petastorm-based DataFrame "
+            "materialization, which is not available in this "
+            "environment; materialize your data and call "
+            "horovod_tpu.spark.run(train_fn) instead.")

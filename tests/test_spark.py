@@ -1,0 +1,42 @@
+"""Spark layer tests.
+
+Role parity: ``test/test_spark.py`` — here reduced to the gating
+behavior plus (when pyspark is present) a local-mode end-to-end run;
+the environment ships no pyspark, so the run path is exercised only on
+clusters that have it.
+"""
+
+import pytest
+
+
+def test_run_gated_without_pyspark():
+    import horovod_tpu.spark as hvd_spark
+
+    if hvd_spark._HAVE_PYSPARK:
+        pytest.skip("pyspark installed; gating not applicable")
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(lambda: None, num_proc=2)
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.KerasEstimator()
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.TorchEstimator()
+
+
+def test_run_local_mode_end_to_end():
+    import horovod_tpu.spark as hvd_spark
+
+    if not hvd_spark._HAVE_PYSPARK:
+        pytest.skip("pyspark not installed")
+
+    def train():
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        out = hvd.allreduce(np.ones(4) * (hvd.rank() + 1), op=hvd.Sum,
+                            name="spark.t")
+        return float(out[0]), hvd.rank(), hvd.size()
+
+    results = hvd_spark.run(train, num_proc=2)
+    assert [r[1] for r in results] == [0, 1]
+    assert all(r[0] == 3.0 and r[2] == 2 for r in results)
