@@ -10,6 +10,28 @@ meshes, in-graph collectives, hierarchical ICI/DCN reduction, sequence
 parallelism) and ``horovod_tpu.ops`` (XLA + Pallas data plane).
 """
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor an explicitly-requested JAX platform *in-process*.  Some
+    # environments install a sitecustomize that registers an out-of-tree
+    # PJRT plugin and force-selects it through jax.config — which silently
+    # overrides the JAX_PLATFORMS env var.  Launched workers (and tests)
+    # rely on that env var, so restore the user's choice before any
+    # backend initializes.  Only acts when the config's first-priority
+    # platform actually differs from the env's (so an env that itself
+    # names the plugin platform is left untouched), and is a no-op once a
+    # backend is live.
+    try:
+        import jax as _jax
+
+        _want = _os.environ["JAX_PLATFORMS"]
+        _have = _jax.config.jax_platforms or ""
+        if _have.split(",")[0].strip() != _want.split(",")[0].strip():
+            _jax.config.update("jax_platforms", _want)
+    except Exception:  # backend already initialized, or no jax — leave it
+        pass
+
 from horovod_tpu.version import __version__  # noqa: F401
 
 from horovod_tpu.basics import (  # noqa: F401

@@ -186,6 +186,69 @@ def make_resnet_train_step(
     return step_fn, init_fn
 
 
+def make_resnet_train_step_hvd(
+    cfg: resnet_model.ResNetConfig,
+    mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    *,
+    axis=("dp",),
+):
+    """Classic-Horovod-contract ResNet step: the whole step runs inside
+    ``shard_map`` and gradient reduction is an *explicit*
+    ``grouped_allreduce`` (via ``DistributedOptimizer``), not a sharding
+    XLA infers — the analog of the reference benchmark always training
+    through ``hvd.DistributedOptimizer``
+    (examples/tensorflow2_synthetic_benchmark.py:119-130).
+
+    Pass ``optimizer`` already wrapped in
+    :func:`horovod_tpu.parallel.optimizer.DistributedOptimizer` (with
+    matching ``axis``) to control op/compression; a default SGD wrapper is
+    built otherwise.  BN statistics and the reported loss are
+    cross-replica averaged.
+    """
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.parallel import optimizer as opt_mod
+    from horovod_tpu.parallel.shard import shard_map
+
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    axes = tuple(a for a in axes if a in mesh.axis_names)
+    if optimizer is None:
+        optimizer = opt_mod.DistributedOptimizer(
+            optax.sgd(0.1, momentum=0.9), axis=axes)
+    rep = _replicated(mesh)
+    batch_p = _batch_spec(mesh, *axes)
+
+    def init_fn(rng) -> ResNetState:
+        params, stats = resnet_model.init(rng, cfg)
+        params = jax.device_put(params, rep)
+        stats = jax.device_put(stats, rep)
+        opt_state = jax.device_put(optimizer.init(params), rep)
+        return ResNetState(params, stats, opt_state,
+                           jnp.zeros((), jnp.int32))
+
+    def body(state: ResNetState, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            resnet_model.loss_fn, has_aux=True)(
+                state.params, state.batch_stats, images, labels, cfg)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        if axes:
+            new_stats = jax.tree.map(
+                lambda s: C.allreduce(s, axis=axes), new_stats)
+            loss = C.allreduce(loss, axis=axes)
+        return ResNetState(params, new_stats, opt_state,
+                           state.step + 1), loss
+
+    sharded = shard_map(
+        body, mesh,
+        in_specs=(P(), batch_p, batch_p),
+        out_specs=(P(), P()),
+    )
+    step_fn = jax.jit(sharded, donate_argnums=(0,))
+    return step_fn, init_fn
+
+
 def make_mnist_train_step(mesh, optimizer=None):
     if optimizer is None:
         optimizer = optax.adam(1e-3)

@@ -1,0 +1,64 @@
+"""JAX platform guards for environments with an out-of-tree TPU tunnel.
+
+Some environments (this one included) register a remote-TPU PJRT plugin via
+``sitecustomize`` and force-select it through ``jax.config`` — overriding
+the ``JAX_PLATFORMS`` env var.  When the tunnel's compile relay is down,
+*any* full backend initialization (``jax.devices()``,
+``jax.process_count()``) hangs forever instead of erroring.  These helpers
+are the one shared copy of the two defenses (used by ``bench.py``,
+``__graft_entry__.py``, and tests):
+
+* :func:`force_cpu_platform` — pin the CPU platform in-process, before any
+  backend init (the only override that survives the sitecustomize hook).
+* :func:`default_backend_alive` — probe the default platform in a
+  subprocess with bounded retry/backoff, so a dead relay is detected
+  without wedging the caller.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Tuple
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Force the JAX CPU platform in-process, before any backend init.
+
+    ``n_devices``: also request that many virtual host devices via
+    ``--xla_force_host_platform_device_count`` (no-op if the flag is
+    already present in ``XLA_FLAGS``).
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def default_backend_alive(timeout: float = 60.0, attempts: int = 2,
+                          backoff_s: float = 3.0
+                          ) -> Tuple[bool, List[str]]:
+    """Probe (in a subprocess, with retry/backoff) whether the default JAX
+    platform can actually initialize.  Returns ``(alive, errors)``."""
+    errors: List[str] = []
+    for i in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout, capture_output=True, text=True)
+            if proc.returncode == 0:
+                return True, errors
+            errors.append(f"rc={proc.returncode}: {proc.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            errors.append(f"timeout after {timeout}s")
+        if i + 1 < attempts:
+            time.sleep(backoff_s * (i + 1))
+    return False, errors

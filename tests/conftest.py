@@ -13,7 +13,10 @@ wraps each pytest file in ``horovodrun -np 2 -H localhost:2``.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard assignment, not setdefault: the outer environment may export
+# JAX_PLATFORMS=axon (TPU tunnel), and tests must run on the virtual CPU
+# mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
