@@ -31,12 +31,49 @@ void Timeline::Shutdown() {
   enabled_ = false;
 }
 
-int Timeline::Tid(const std::string& tensor) {
+// Tensor names are user-controlled (arbitrary Python strings); anything
+// interpolated into the trace must be escaped or the JSON breaks.
+static std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char u[8];
+          std::snprintf(u, sizeof(u), "\\u%04x", c);
+          out += u;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+int Timeline::Tid(const std::string& tensor, std::string* meta) {
   if (tensor.empty()) return 0;
   auto it = tensor_tids_.find(tensor);
   if (it != tensor_tids_.end()) return it->second;
   int tid = static_cast<int>(tensor_tids_.size()) + 1;
   tensor_tids_[tensor] = tid;
+  // First sighting: name the lane after the tensor (chrome-tracing
+  // thread_name metadata), like the reference's per-tensor timeline rows.
+  if (meta != nullptr) {
+    char buf[512];
+    int n = std::snprintf(buf, sizeof(buf),
+                          "{\"ph\": \"M\", \"pid\": 0, \"tid\": %d, "
+                          "\"name\": \"thread_name\", \"args\": "
+                          "{\"name\": \"%s\"}},\n",
+                          tid, JsonEscape(tensor).c_str());
+    if (n > 0 && static_cast<size_t>(n) < sizeof(buf))
+      meta->assign(buf, static_cast<size_t>(n));
+  }
   return tid;
 }
 
@@ -47,25 +84,28 @@ void Timeline::Emit(char ph, const std::string& name,
                 std::chrono::steady_clock::now() - start_)
                 .count() /
             1e3;
+  std::string meta;
+  int tid = Tid(tensor, &meta);
   char buf[512];
   int n;
   if (name.empty()) {
     n = std::snprintf(buf, sizeof(buf),
                       "{\"ph\": \"%c\", \"ts\": %.3f, \"pid\": 0, "
                       "\"tid\": %d},\n",
-                      ph, us, Tid(tensor));
+                      ph, us, tid);
   } else {
     n = std::snprintf(buf, sizeof(buf),
                       "{\"ph\": \"%c\", \"ts\": %.3f, \"pid\": 0, "
                       "\"tid\": %d, \"name\": \"%s\"},\n",
-                      ph, us, Tid(tensor), name.c_str());
+                      ph, us, tid, JsonEscape(name).c_str());
   }
-  if (n <= 0) return;
-  // snprintf returns the would-have-been length on truncation.
-  size_t len = std::min(static_cast<size_t>(n), sizeof(buf) - 1);
+  // snprintf returns the would-have-been length on truncation; a
+  // truncated record would be malformed JSON, so drop it instead.
+  if (n <= 0 || static_cast<size_t>(n) >= sizeof(buf)) return;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    queue_.emplace_back(buf, len);
+    if (!meta.empty()) queue_.emplace_back(std::move(meta));
+    queue_.emplace_back(buf, static_cast<size_t>(n));
   }
   cv_.notify_one();
 }

@@ -38,7 +38,7 @@ class Timeline {
 
  private:
   void Emit(char ph, const std::string& name, const std::string& tensor);
-  int Tid(const std::string& tensor);
+  int Tid(const std::string& tensor, std::string* meta = nullptr);
   void WriterLoop();
 
   bool enabled_ = false;

@@ -79,13 +79,25 @@ def _get_lr(optimizer) -> float:
 
 
 def _set_lr(optimizer, lr: float, momentum_correction: bool) -> None:
+    # Momentum correction (Goyal et al., the recipe behind
+    # _keras/callbacks.py:120-134): when the LR changes old→new, the SGD
+    # velocity v (which has the LR folded in: v ← m·v − lr·g) must be
+    # rescaled by new/old.  The reference does it by scaling the momentum
+    # *coefficient* for exactly the next update and restoring it
+    # afterwards:  v' = (m·new/old)·v − new·g.  Here we rescale the
+    # momentum *buffers* once at the change instead:  v ← (new/old)·v,
+    # then v' = m·v − new·g — algebraically identical, including under a
+    # per-batch warmup ramp (each change applies its own old/new ratio
+    # exactly once).  This intentional divergence exists because in
+    # Keras 3 ``optimizer.momentum`` is a plain Python float baked into
+    # the compiled update step — mutating it between batches does not
+    # reliably take effect — while the velocity slots
+    # (``optimizer.momentums``) are real variables whose assignment
+    # always does.
     old = _get_lr(optimizer)
     optimizer.learning_rate = lr
     if momentum_correction and old > 0 and lr != old and \
             getattr(optimizer, "momentums", None):
-        # Parity: the reference rescales momentum buffers by new/old LR
-        # around schedule changes so the implicit velocity stays
-        # consistent (_keras/callbacks.py momentum_correction).
         scale = lr / old
         for m in optimizer.momentums:
             m.assign(m * scale)

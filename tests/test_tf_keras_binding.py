@@ -43,15 +43,16 @@ def run_tf_workers(scenario, np_=2, timeout=240.0):
                 [sys.executable, WORKER, scenario], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE))
         deadline = time.monotonic() + timeout
+        outs = []
         for p in procs:
             remaining = max(1.0, deadline - time.monotonic())
             try:
-                p.communicate(timeout=remaining)
+                out, err = p.communicate(timeout=remaining)
             except subprocess.TimeoutExpired:
                 for q in procs:
                     q.kill()
                 raise AssertionError(f"tf scenario {scenario} timed out")
-        outs = [(p.returncode, *p.communicate()) for p in procs]
+            outs.append((p.returncode, out, err))
         for rank, (code, out, err) in enumerate(outs):
             assert code == 0, (
                 f"tf scenario {scenario} rank {rank} failed "
