@@ -169,6 +169,8 @@ ParameterManager::ParameterManager(const TunedParams& initial,
   if (opts_.tune_fusion) dims_.push_back("fusion");
   if (opts_.tune_cycle) dims_.push_back("cycle");
   if (opts_.tune_cache) dims_.push_back("cache");
+  if (opts_.tune_hier_allreduce) dims_.push_back("hier_ar");
+  if (opts_.tune_hier_allgather) dims_.push_back("hier_ag");
   bo_ = BayesianOptimization(std::max<int>(1, dims_.size()));
   current_x_ = ParamsToX(initial);
   if (!opts_.log_path.empty()) {
@@ -176,7 +178,8 @@ ParameterManager::ParameterManager(const TunedParams& initial,
     if (f)
       std::fprintf(f,
                    "sample,score_bytes_per_s,fusion_threshold,"
-                   "cycle_time_ms,cache_enabled\n");
+                   "cycle_time_ms,cache_enabled,hierarchical_allreduce,"
+                   "hierarchical_allgather\n");
     log_file_ = f;
   }
 }
@@ -192,6 +195,10 @@ std::vector<double> ParameterManager::ParamsToX(const TunedParams& p) const {
       x.push_back(double(p.fusion_threshold) / kMaxFusion);
     else if (d == "cycle")
       x.push_back((p.cycle_time_s - kMinCycleS) / (kMaxCycleS - kMinCycleS));
+    else if (d == "hier_ar")
+      x.push_back(p.hierarchical_allreduce ? 1.0 : 0.0);
+    else if (d == "hier_ag")
+      x.push_back(p.hierarchical_allgather ? 1.0 : 0.0);
     else
       x.push_back(p.cache_enabled ? 1.0 : 0.0);
   }
@@ -208,6 +215,10 @@ TunedParams ParameterManager::XToParams(const std::vector<double>& x) const {
           int64_t(std::llround(v * kMaxFusion / (1 << 20))) << 20;
     else if (dims_[i] == "cycle")
       p.cycle_time_s = kMinCycleS + v * (kMaxCycleS - kMinCycleS);
+    else if (dims_[i] == "hier_ar")
+      p.hierarchical_allreduce = v >= 0.5;
+    else if (dims_[i] == "hier_ag")
+      p.hierarchical_allgather = v >= 0.5;
     else
       p.cache_enabled = v >= 0.5;
   }
@@ -218,13 +229,17 @@ void ParameterManager::Log(int sample, double score) {
   if (!log_file_) return;
   FILE* f = static_cast<FILE*>(log_file_);
   if (sample < 0)  // settled row, mirroring the Python tuner's format
-    std::fprintf(f, "final,,%lld,%.3f,%d\n",
+    std::fprintf(f, "final,,%lld,%.3f,%d,%d,%d\n",
                  static_cast<long long>(current_.fusion_threshold),
-                 current_.cycle_time_s * 1e3, current_.cache_enabled ? 1 : 0);
+                 current_.cycle_time_s * 1e3, current_.cache_enabled ? 1 : 0,
+                 current_.hierarchical_allreduce ? 1 : 0,
+                 current_.hierarchical_allgather ? 1 : 0);
   else
-    std::fprintf(f, "%d,%.1f,%lld,%.3f,%d\n", sample, score,
+    std::fprintf(f, "%d,%.1f,%lld,%.3f,%d,%d,%d\n", sample, score,
                  static_cast<long long>(current_.fusion_threshold),
-                 current_.cycle_time_s * 1e3, current_.cache_enabled ? 1 : 0);
+                 current_.cycle_time_s * 1e3, current_.cache_enabled ? 1 : 0,
+                 current_.hierarchical_allreduce ? 1 : 0,
+                 current_.hierarchical_allgather ? 1 : 0);
   std::fflush(f);
 }
 

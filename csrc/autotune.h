@@ -69,6 +69,8 @@ struct TunedParams {
   int64_t fusion_threshold = 64 << 20;
   double cycle_time_s = 0.005;
   bool cache_enabled = true;
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
 };
 
 // Rank-0 tuner: feed allreduced bytes, get knob updates to broadcast.
@@ -78,6 +80,10 @@ class ParameterManager {
     bool tune_fusion = true;
     bool tune_cycle = true;
     bool tune_cache = true;
+    // Only meaningful on hierarchical topologies; the engine gates these
+    // on local_size>1 && cross_size>1 before constructing the manager.
+    bool tune_hier_allreduce = false;
+    bool tune_hier_allgather = false;
     int warmup_samples = 3;
     int max_samples = 20;
     double sample_duration_s = 0.5;

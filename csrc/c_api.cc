@@ -38,8 +38,10 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
                const int32_t* ctrl_fds, double cycle_time_s,
                int64_t fusion_threshold, double stall_warn_s,
                double stall_shutdown_s, int stall_check_disable,
-               int64_t cache_capacity, int autotune, int tune_fusion,
-               int tune_cycle, int tune_cache, int autotune_warmup,
+               int64_t cache_capacity, int hierarchical_allreduce,
+               int hierarchical_allgather, int autotune, int tune_fusion,
+               int tune_cycle, int tune_cache, int tune_hier_allreduce,
+               int tune_hier_allgather, int autotune_warmup,
                int autotune_max_samples, double autotune_sample_duration_s,
                const char* autotune_log, const char* timeline_path,
                int timeline_mark_cycles) {
@@ -60,15 +62,20 @@ int hvd_create(int rank, int size, int local_rank, int local_size,
   cfg.stall_shutdown_s = stall_shutdown_s;
   cfg.stall_check_disable = stall_check_disable != 0;
   cfg.cache_capacity = cache_capacity;
+  cfg.hierarchical_allreduce = hierarchical_allreduce != 0;
+  cfg.hierarchical_allgather = hierarchical_allgather != 0;
   // Autotune knobs arrive pre-parsed from Python (env_util), same path
   // as every other knob, so both engines read env identically.
   cfg.autotune = autotune != 0 &&
-                 (tune_fusion != 0 || tune_cycle != 0 || tune_cache != 0);
+                 (tune_fusion != 0 || tune_cycle != 0 || tune_cache != 0 ||
+                  tune_hier_allreduce != 0 || tune_hier_allgather != 0);
   if (cfg.autotune) {
     auto& o = cfg.autotune_opts;
     o.tune_fusion = tune_fusion != 0;
     o.tune_cycle = tune_cycle != 0;
     o.tune_cache = tune_cache != 0;
+    o.tune_hier_allreduce = tune_hier_allreduce != 0;
+    o.tune_hier_allgather = tune_hier_allgather != 0;
     o.warmup_samples = autotune_warmup;
     o.max_samples = autotune_max_samples;
     o.sample_duration_s = autotune_sample_duration_s;

@@ -265,11 +265,20 @@ Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
   cache_.SetCapacity(cfg.cache_capacity);
   if (cfg.rank == 0 && !cfg.timeline_path.empty())
     timeline_.Initialize(cfg.timeline_path, cfg.timeline_mark_cycles);
-  if (cfg.autotune && cfg.rank == 0)
+  if (cfg.autotune && cfg.rank == 0) {
+    auto opts = cfg.autotune_opts;
+    if (!HierarchicalTopologyOk()) {
+      // A hierarchy knob is meaningless on a flat topology — tuning it
+      // would waste GP samples on a no-op dimension.
+      opts.tune_hier_allreduce = false;
+      opts.tune_hier_allgather = false;
+    }
     pm_ = std::make_unique<ParameterManager>(
         TunedParams{cfg.fusion_threshold, cfg.cycle_time_s,
-                    cfg.cache_capacity > 0},
-        cfg.autotune_opts);
+                    cfg.cache_capacity > 0, cfg.hierarchical_allreduce,
+                    cfg.hierarchical_allgather},
+        opts);
+  }
   bg_ = std::thread([this] { BackgroundLoop(); });
 }
 
@@ -534,6 +543,8 @@ void Engine::ClassifyRequests(std::vector<Request> msgs,
 void Engine::ApplyParams(const WireParams& p) {
   cfg_.fusion_threshold = p.fusion_threshold;
   cfg_.cycle_time_s = p.cycle_time_s;
+  cfg_.hierarchical_allreduce = p.hierarchical_allreduce;
+  cfg_.hierarchical_allgather = p.hierarchical_allgather;
   std::lock_guard<std::mutex> lk(cache_mu_);
   cache_classify_enabled_ = p.cache_enabled;
 }
@@ -546,9 +557,16 @@ void Engine::ExecuteCachedHits(const std::vector<uint32_t>& hit_positions) {
     for (auto p : hit_positions) {
       const Response* resp = cache_.GetByPosition(p);
       if (resp == nullptr) {
-        std::fprintf(stderr, "[hvd-core %d] cache position %u missing\n",
+        // A missing position means this rank's cache diverged from the
+        // coordinator's.  Executing the remaining hits would launch a
+        // different collective sequence than the other ranks and hang
+        // the whole job — fail fast instead.
+        std::fprintf(stderr,
+                     "[hvd-core %d] cache coherence violation: position %u "
+                     "missing locally, aborting\n",
                      cfg_.rank, p);
-        continue;
+        Abort("response cache coherence violation");
+        return;
       }
       cache_.Touch(p);
       cached.push_back(*resp);  // copy: FuseResponses mutates its inputs
@@ -735,6 +753,8 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
       wp.fusion_threshold = pending_params_.fusion_threshold;
       wp.cycle_time_s = pending_params_.cycle_time_s;
       wp.cache_enabled = pending_params_.cache_enabled;
+      wp.hierarchical_allreduce = pending_params_.hierarchical_allreduce;
+      wp.hierarchical_allgather = pending_params_.hierarchical_allgather;
       have_pending_params_ = false;
     }
     std::vector<uint8_t> shared;
@@ -1121,6 +1141,8 @@ void Engine::DoAllreduce(std::vector<TensorTableEntry>& entries,
 
   if (op == ReduceOp::ADASUM) {
     AdasumFlat(flat, total, dt);
+  } else if (cfg_.hierarchical_allreduce && HierarchicalTopologyOk()) {
+    HierarchicalAllreduceFlat(flat, total, dt, op);
   } else {
     RingAllreduceFlat(flat, total, dt, op);
   }
@@ -1146,18 +1168,26 @@ void Engine::RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
   // Parity: cpu_backend.ring_allreduce_flat — ring reduce-scatter +
   // ring allgather, chunk boundaries and combine order identical so the
   // two engines are bit-identical (they can share one job).
-  int size = cfg_.size, rank = cfg_.rank;
+  std::vector<int> group(cfg_.size);
+  for (int i = 0; i < cfg_.size; ++i) group[i] = i;
+  RingAllreduceGroup(buf, nelems, dt, op, group, cfg_.rank);
+}
+
+void Engine::RingAllreduceGroup(uint8_t* buf, int64_t nelems, DataType dt,
+                                ReduceOp op, const std::vector<int>& group,
+                                int me) {
+  int size = static_cast<int>(group.size());
   if (size == 1) return;
   size_t isz = ItemSize(dt);
-  int right = data_fds_[Mod(rank + 1, size)];
-  int left = data_fds_[Mod(rank - 1, size)];
+  int right = data_fds_[group[Mod(me + 1, size)]];
+  int left = data_fds_[group[Mod(me - 1, size)]];
   auto bounds = ChunkBounds(nelems, size);
   std::vector<uint8_t> tmp;
 
   // Phase 1: ring reduce-scatter.
   for (int step = 0; step < size - 1; ++step) {
-    int64_t send_idx = Mod(rank - step, size);
-    int64_t recv_idx = Mod(rank - step - 1, size);
+    int64_t send_idx = Mod(me - step, size);
+    int64_t recv_idx = Mod(me - step - 1, size);
     int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
     int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
     tmp.resize(recv_n * isz);
@@ -1168,8 +1198,78 @@ void Engine::RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
 
   // Phase 2: ring allgather of the reduced chunks.
   for (int step = 0; step < size - 1; ++step) {
-    int64_t send_idx = Mod(rank + 1 - step, size);
-    int64_t recv_idx = Mod(rank - step, size);
+    int64_t send_idx = Mod(me + 1 - step, size);
+    int64_t recv_idx = Mod(me - step, size);
+    int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
+    int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
+    ExchangeInto(right, buf + bounds[send_idx] * isz, send_n * isz, left,
+                 buf + bounds[recv_idx] * isz, recv_n * isz);
+  }
+}
+
+bool Engine::HierarchicalTopologyOk() const {
+  // Requires the launcher's homogeneous block rank layout
+  // (rank = cross_rank*local_size + local_rank) and a true two-level
+  // shape.
+  return cfg_.local_size > 1 && cfg_.cross_size > 1 &&
+         cfg_.local_size * cfg_.cross_size == cfg_.size &&
+         cfg_.rank == cfg_.cross_rank * cfg_.local_size + cfg_.local_rank;
+}
+
+std::vector<int> Engine::LocalGroup() const {
+  std::vector<int> g(cfg_.local_size);
+  for (int i = 0; i < cfg_.local_size; ++i)
+    g[i] = cfg_.cross_rank * cfg_.local_size + i;
+  return g;
+}
+
+std::vector<int> Engine::CrossGroup() const {
+  std::vector<int> g(cfg_.cross_size);
+  for (int k = 0; k < cfg_.cross_size; ++k)
+    g[k] = k * cfg_.local_size + cfg_.local_rank;
+  return g;
+}
+
+void Engine::HierarchicalAllreduceFlat(uint8_t* buf, int64_t nelems,
+                                       DataType dt, ReduceOp op) {
+  // Two-level TPU mapping of NCCLHierarchicalAllreduce
+  // (nccl_operations.cc:163-363): reduce-scatter on the node-local ring,
+  // allreduce the owned 1/local_size slice on the cross-node ring, then
+  // allgather on the local ring — only 1/local_size of the bytes crosses
+  // the slow fabric.  Chunk walk identical to cpu_backend so the two
+  // engines stay bit-compatible in a mixed job.
+  int L = cfg_.local_size;
+  int li = cfg_.local_rank;
+  size_t isz = ItemSize(dt);
+  auto local = LocalGroup();
+  int right = data_fds_[local[Mod(li + 1, L)]];
+  int left = data_fds_[local[Mod(li - 1, L)]];
+  auto bounds = ChunkBounds(nelems, L);
+  std::vector<uint8_t> tmp;
+
+  // Phase 1: local ring reduce-scatter.
+  for (int step = 0; step < L - 1; ++step) {
+    int64_t send_idx = Mod(li - step, L);
+    int64_t recv_idx = Mod(li - step - 1, L);
+    int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
+    int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
+    tmp.resize(recv_n * isz);
+    ExchangeInto(right, buf + bounds[send_idx] * isz, send_n * isz, left,
+                 tmp.data(), recv_n * isz);
+    CombineInto(buf + bounds[recv_idx] * isz, tmp.data(), recv_n, dt, op);
+  }
+
+  // Phase 2: cross-node ring allreduce of the fully-reduced owned chunk.
+  int64_t own = Mod(li + 1, L);
+  int64_t own_n = bounds[own + 1] - bounds[own];
+  if (own_n > 0)
+    RingAllreduceGroup(buf + bounds[own] * isz, own_n, dt, op, CrossGroup(),
+                       cfg_.cross_rank);
+
+  // Phase 3: local ring allgather.
+  for (int step = 0; step < L - 1; ++step) {
+    int64_t send_idx = Mod(li + 1 - step, L);
+    int64_t recv_idx = Mod(li - step, L);
     int64_t send_n = bounds[send_idx + 1] - bounds[send_idx];
     int64_t recv_n = bounds[recv_idx + 1] - bounds[recv_idx];
     ExchangeInto(right, buf + bounds[send_idx] * isz, send_n * isz, left,
@@ -1201,6 +1301,10 @@ void Engine::AdasumFlat(uint8_t* buf, int64_t nelems, DataType dt) {
 
 void Engine::DoAllgather(std::vector<TensorTableEntry>& entries,
                          const Response& resp) {
+  if (cfg_.hierarchical_allgather && HierarchicalTopologyOk()) {
+    DoAllgatherHierarchical(entries, resp);
+    return;
+  }
   // Ragged ring allgatherv (parity: cpu_backend.allgather; displacement
   // logic parity: MPIAllgather, mpi_operations.cc:83-166).
   int size = cfg_.size, rank = cfg_.rank;
@@ -1235,6 +1339,93 @@ void Engine::DoAllgather(std::vector<TensorTableEntry>& entries,
     for (auto& b : blocks) {
       if (b.len) std::memcpy(result.data() + off, b.ptr, b.len);
       off += b.len;
+    }
+    ReleaseName(e.name);
+    if (e.handle >= 0)
+      handles_.MarkDone(e.handle, Status::OK(), std::move(result));
+  }
+}
+
+void Engine::DoAllgatherHierarchical(std::vector<TensorTableEntry>& entries,
+                                     const Response& resp) {
+  // Two-level allgatherv (role parity: MPIHierarchicalAllgather,
+  // mpi_operations.cc:168-309 — there via a node-shared MPI window;
+  // here via the node-local ring + a leaders-only cross ring):
+  //   1. ragged ring allgatherv within the node → node block,
+  //   2. local leaders exchange node blocks on the cross ring,
+  //   3. leaders fan the full buffer out to their node (MultiSend).
+  // Output ordering matches the flat path because the launcher's block
+  // rank layout makes node blocks contiguous in global rank order.
+  int L = cfg_.local_size, li = cfg_.local_rank, C = cfg_.cross_size;
+  auto local = LocalGroup();
+  for (auto& e : entries) {
+    // Phase 1: node-local ragged ring allgatherv.
+    struct Block {
+      const uint8_t* ptr = nullptr;
+      size_t len = 0;
+      std::vector<uint8_t> own;
+    };
+    std::vector<Block> blocks(L);
+    blocks[li].ptr = e.data;
+    blocks[li].len = e.nelems * ItemSize(resp.tensor_type);
+    int right = data_fds_[local[Mod(li + 1, L)]];
+    int left = data_fds_[local[Mod(li - 1, L)]];
+    for (int step = 0; step < L - 1; ++step) {
+      int64_t send_idx = Mod(li - step, L);
+      int64_t recv_idx = Mod(li - step - 1, L);
+      std::vector<uint8_t> incoming;
+      Exchange(right, blocks[send_idx].ptr, blocks[send_idx].len, left,
+               &incoming);
+      blocks[recv_idx].own = std::move(incoming);
+      blocks[recv_idx].ptr = blocks[recv_idx].own.data();
+      blocks[recv_idx].len = blocks[recv_idx].own.size();
+    }
+    size_t node_bytes = 0;
+    for (auto& b : blocks) node_bytes += b.len;
+    std::vector<uint8_t> node_block(node_bytes);
+    size_t off = 0;
+    for (auto& b : blocks) {
+      if (b.len) std::memcpy(node_block.data() + off, b.ptr, b.len);
+      off += b.len;
+    }
+
+    std::vector<uint8_t> result;
+    if (li == 0) {
+      // Phase 2: leaders' ragged ring allgatherv of node blocks.
+      std::vector<Block> nblocks(C);
+      int me = cfg_.cross_rank;
+      nblocks[me].ptr = node_block.data();
+      nblocks[me].len = node_block.size();
+      if (C > 1) {
+        int nright = data_fds_[Mod(me + 1, C) * L];
+        int nleft = data_fds_[Mod(me - 1, C) * L];
+        for (int step = 0; step < C - 1; ++step) {
+          int64_t send_idx = Mod(me - step, C);
+          int64_t recv_idx = Mod(me - step - 1, C);
+          std::vector<uint8_t> incoming;
+          Exchange(nright, nblocks[send_idx].ptr, nblocks[send_idx].len,
+                   nleft, &incoming);
+          nblocks[recv_idx].own = std::move(incoming);
+          nblocks[recv_idx].ptr = nblocks[recv_idx].own.data();
+          nblocks[recv_idx].len = nblocks[recv_idx].own.size();
+        }
+      }
+      size_t total = 0;
+      for (auto& b : nblocks) total += b.len;
+      result.resize(total);
+      off = 0;
+      for (auto& b : nblocks) {
+        if (b.len) std::memcpy(result.data() + off, b.ptr, b.len);
+        off += b.len;
+      }
+      // Phase 3: fan out to the rest of the node.
+      std::vector<int> others;
+      for (int i = 1; i < L; ++i) others.push_back(data_fds_[local[i]]);
+      MultiSend(others, result.data(), result.size());
+    } else {
+      uint8_t tag = RecvFrame(data_fds_[local[0]], &result);
+      if (tag != kTagData)
+        throw std::runtime_error("hierarchical allgather: bad frame tag");
     }
     ReleaseName(e.name);
     if (e.handle >= 0)

@@ -94,6 +94,12 @@ struct EngineConfig {
   double stall_shutdown_s = 0.0;
   bool stall_check_disable = false;
   int64_t cache_capacity = 1024;  // 0 disables the response cache
+  // Two-level data plane: local ring reduce-scatter → cross ring
+  // allreduce → local ring allgather (the NCCLHierarchicalAllreduce
+  // shape, nccl_operations.cc:163-363).  Effective only when the
+  // topology is actually hierarchical (local_size>1 && cross_size>1).
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
   // Autotuner (coordinator only; parity: parameter_manager.cc).
   bool autotune = false;
   ParameterManager::Options autotune_opts;
@@ -221,6 +227,8 @@ class Engine {
                    const Response& resp);
   void DoAllgather(std::vector<TensorTableEntry>& entries,
                    const Response& resp);
+  void DoAllgatherHierarchical(std::vector<TensorTableEntry>& entries,
+                               const Response& resp);
   void DoBroadcast(std::vector<TensorTableEntry>& entries,
                    const Response& resp);
   void DoAlltoall(std::vector<TensorTableEntry>& entries,
@@ -230,6 +238,17 @@ class Engine {
   // Data plane.
   void RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
                          ReduceOp op);
+  // Ring allreduce restricted to `group` (global ranks, any order);
+  // `me` is this rank's index within it.
+  void RingAllreduceGroup(uint8_t* buf, int64_t nelems, DataType dt,
+                          ReduceOp op, const std::vector<int>& group,
+                          int me);
+  void HierarchicalAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
+                                 ReduceOp op);
+  // True when hierarchical mode can actually run on this topology.
+  bool HierarchicalTopologyOk() const;
+  std::vector<int> LocalGroup() const;
+  std::vector<int> CrossGroup() const;
   void AdasumFlat(uint8_t* buf, int64_t nelems, DataType dt);
 
   EngineConfig cfg_;

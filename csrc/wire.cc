@@ -209,6 +209,8 @@ std::vector<uint8_t> EncodeResponseList(
     PutI64(b, params.fusion_threshold);
     PutF64(b, params.cycle_time_s);
     PutU8(b, params.cache_enabled ? 1 : 0);
+    PutU8(b, params.hierarchical_allreduce ? 1 : 0);
+    PutU8(b, params.hierarchical_allgather ? 1 : 0);
   }
   return b;
 }
@@ -234,6 +236,8 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
     params->fusion_threshold = rd.I64();
     params->cycle_time_s = rd.F64();
     params->cache_enabled = rd.U8() != 0;
+    params->hierarchical_allreduce = rd.U8() != 0;
+    params->hierarchical_allgather = rd.U8() != 0;
   }
   return !rd.fail;
 }

@@ -72,6 +72,8 @@ struct WireParams {
   int64_t fusion_threshold = 0;
   double cycle_time_s = 0;
   bool cache_enabled = true;
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
 };
 
 std::vector<uint8_t> EncodeResponseList(

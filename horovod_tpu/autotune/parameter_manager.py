@@ -9,14 +9,12 @@ in the response stream and applied before they take effect on any
 coherence-relevant path (fusion of cached hits must use the same
 threshold on every rank).
 
-Differences from the reference, by design:
-* knobs are (fusion threshold, cycle time, cache on/off); the reference
-  also tunes hierarchical-allreduce/allgather toggles, which have no
-  meaning for the single-level TCP/ICI data plane here (the hierarchical
-  path lives in the in-graph XLA backend, see
-  ``horovod_tpu.ops.collective.hierarchical_allreduce``).
-* categorical dims ride the same GP with rounding instead of separate
-  per-category optimizers.
+Knobs: fusion threshold, cycle time, cache on/off, and — on hierarchical
+topologies (local_size>1 and cross_size>1) — the hierarchical
+allreduce/allgather toggles, matching the reference's tunable set
+(``parameter_manager.cc:44-60``).  One difference by design: categorical
+dims ride the same GP with rounding instead of separate per-category
+optimizers.
 
 Explicitly set env knobs are *fixed* and excluded from tuning (parity:
 ``parameter_manager.h:60-78`` — fixed=true wins over tuning).
@@ -39,24 +37,34 @@ _MIN_CYCLE_S = 0.0005
 _MAX_CYCLE_S = 0.025
 
 
-def autotune_options_from_env() -> Optional[dict]:
+def autotune_options_from_env(hierarchical_ok: bool = False
+                              ) -> Optional[dict]:
     """The single source of the autotune env policy, shared by the Python
     engine (ParameterManager.from_env) and the native engine (which ships
     these values through hvd_create).  None when tuning is off or every
-    knob is env-pinned."""
+    knob is env-pinned.  ``hierarchical_ok``: the hierarchy toggles are
+    only tunable on a topology where they do anything."""
     if not env_util.get_bool(env_util.AUTOTUNE, False):
         return None
     opts = dict(
         tune_fusion=env_util.FUSION_THRESHOLD not in os.environ,
         tune_cycle=env_util.CYCLE_TIME not in os.environ,
         tune_cache=env_util.CACHE_CAPACITY not in os.environ,
+        tune_hier_allreduce=(
+            hierarchical_ok
+            and env_util.HIERARCHICAL_ALLREDUCE not in os.environ),
+        tune_hier_allgather=(
+            hierarchical_ok
+            and env_util.HIERARCHICAL_ALLGATHER not in os.environ),
         warmup_samples=env_util.get_int(env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
         max_samples=env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
         sample_duration_s=env_util.get_float(
             env_util.AUTOTUNE_SAMPLE_DURATION, 0.5),
         log_path=env_util.get_str(env_util.AUTOTUNE_LOG) or None,
     )
-    if not (opts["tune_fusion"] or opts["tune_cycle"] or opts["tune_cache"]):
+    if not any(opts[k] for k in ("tune_fusion", "tune_cycle", "tune_cache",
+                                 "tune_hier_allreduce",
+                                 "tune_hier_allgather")):
         return None
     return opts
 
@@ -68,11 +76,17 @@ class TunedParams:
     fusion_threshold: int
     cycle_time_s: float
     cache_enabled: bool
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
 
     def __eq__(self, other) -> bool:
         return (self.fusion_threshold == other.fusion_threshold
                 and abs(self.cycle_time_s - other.cycle_time_s) < 1e-9
-                and self.cache_enabled == other.cache_enabled)
+                and self.cache_enabled == other.cache_enabled
+                and self.hierarchical_allreduce
+                == other.hierarchical_allreduce
+                and self.hierarchical_allgather
+                == other.hierarchical_allgather)
 
 
 class ParameterManager:
@@ -81,6 +95,8 @@ class ParameterManager:
     def __init__(self, initial: TunedParams, *,
                  tune_fusion: bool = True, tune_cycle: bool = True,
                  tune_cache: bool = True,
+                 tune_hier_allreduce: bool = False,
+                 tune_hier_allgather: bool = False,
                  warmup_samples: int = 3, max_samples: int = 20,
                  sample_duration_s: float = 0.5,
                  log_path: Optional[str] = None):
@@ -94,6 +110,10 @@ class ParameterManager:
             self._dims.append("cycle")
         if tune_cache:
             self._dims.append("cache")
+        if tune_hier_allreduce:
+            self._dims.append("hier_ar")
+        if tune_hier_allgather:
+            self._dims.append("hier_ag")
         self._bo = BayesianOptimization(dim=max(1, len(self._dims)))
         self._warmup_left = warmup_samples
         self._max_samples = max_samples
@@ -106,17 +126,23 @@ class ParameterManager:
         if self._log:
             self._log.write(
                 "sample,score_bytes_per_s,fusion_threshold,"
-                "cycle_time_ms,cache_enabled\n")
+                "cycle_time_ms,cache_enabled,hierarchical_allreduce,"
+                "hierarchical_allgather\n")
 
     @classmethod
-    def from_env(cls, fusion_threshold: int,
-                 cycle_time_s: float) -> Optional["ParameterManager"]:
+    def from_env(cls, fusion_threshold: int, cycle_time_s: float,
+                 hierarchical_allreduce: bool = False,
+                 hierarchical_allgather: bool = False,
+                 hierarchical_ok: bool = False
+                 ) -> Optional["ParameterManager"]:
         """None unless HVD_AUTOTUNE is on.  Env-pinned knobs are fixed;
         if every knob is pinned there is nothing to tune."""
-        opts = autotune_options_from_env()
+        opts = autotune_options_from_env(hierarchical_ok)
         if opts is None:
             return None
-        return cls(TunedParams(fusion_threshold, cycle_time_s, True), **opts)
+        return cls(TunedParams(fusion_threshold, cycle_time_s, True,
+                               hierarchical_allreduce,
+                               hierarchical_allgather), **opts)
 
     # -- parameter vector mapping ----------------------------------------
 
@@ -128,6 +154,10 @@ class ParameterManager:
             elif d == "cycle":
                 x.append((p.cycle_time_s - _MIN_CYCLE_S) /
                          (_MAX_CYCLE_S - _MIN_CYCLE_S))
+            elif d == "hier_ar":
+                x.append(1.0 if p.hierarchical_allreduce else 0.0)
+            elif d == "hier_ag":
+                x.append(1.0 if p.hierarchical_allgather else 0.0)
             else:
                 x.append(1.0 if p.cache_enabled else 0.0)
         return np.asarray(x or [0.0], np.float64)
@@ -135,7 +165,9 @@ class ParameterManager:
     def _x_to_params(self, x: np.ndarray) -> TunedParams:
         p = TunedParams(self.current.fusion_threshold,
                         self.current.cycle_time_s,
-                        self.current.cache_enabled)
+                        self.current.cache_enabled,
+                        self.current.hierarchical_allreduce,
+                        self.current.hierarchical_allgather)
         for i, d in enumerate(self._dims):
             v = float(np.clip(x[i], 0.0, 1.0))
             if d == "fusion":
@@ -145,6 +177,10 @@ class ParameterManager:
             elif d == "cycle":
                 p.cycle_time_s = _MIN_CYCLE_S + v * (_MAX_CYCLE_S -
                                                      _MIN_CYCLE_S)
+            elif d == "hier_ar":
+                p.hierarchical_allreduce = v >= 0.5
+            elif d == "hier_ag":
+                p.hierarchical_allgather = v >= 0.5
             else:
                 p.cache_enabled = v >= 0.5
         return p
@@ -188,7 +224,9 @@ class ParameterManager:
                 f"{self._samples},{score:.1f},"
                 f"{self.current.fusion_threshold},"
                 f"{self.current.cycle_time_s * 1e3:.3f},"
-                f"{int(self.current.cache_enabled)}\n")
+                f"{int(self.current.cache_enabled)},"
+                f"{int(self.current.hierarchical_allreduce)},"
+                f"{int(self.current.hierarchical_allgather)}\n")
             self._log.flush()
 
         if self._samples >= self._max_samples:
@@ -200,7 +238,9 @@ class ParameterManager:
                 self._log.write(
                     f"final,,{self.current.fusion_threshold},"
                     f"{self.current.cycle_time_s * 1e3:.3f},"
-                    f"{int(self.current.cache_enabled)}\n")
+                    f"{int(self.current.cache_enabled)},"
+                    f"{int(self.current.hierarchical_allreduce)},"
+                    f"{int(self.current.hierarchical_allgather)}\n")
                 self._log.close()
                 self._log = None
             return self.current

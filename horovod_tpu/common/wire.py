@@ -28,7 +28,8 @@ Layout (all integers little-endian):
                   u32 n_resend, varstr resend_names[n_resend],
                   u8 has_params,
                   [ i64 fusion_threshold, f64 cycle_time_s,
-                    u8 cache_enabled ]   # iff has_params
+                    u8 cache_enabled, u8 hierarchical_allreduce,
+                    u8 hierarchical_allgather ]   # iff has_params
 
 ``has_params`` carries the autotuner's knob broadcast (parity: rank 0
 tuning + Params bcast, ``parameter_manager.cc`` via ``controller.cc:33-47``);
@@ -221,10 +222,12 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
 def encode_response_list(resps: List[Response], shutdown: bool = False,
                          hit_positions: List[int] = (),
                          resend_names: List[str] = (),
-                         params: Optional[Tuple[int, float, bool]] = None
+                         params: Optional[Tuple[int, float, bool,
+                                                bool, bool]] = None
                          ) -> bytes:
-    """``params``: (fusion_threshold, cycle_time_s, cache_enabled) knob
-    broadcast from the autotuner, or None."""
+    """``params``: (fusion_threshold, cycle_time_s, cache_enabled,
+    hierarchical_allreduce, hierarchical_allgather) knob broadcast from
+    the autotuner, or None."""
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(resps))
     for r in resps:
@@ -238,15 +241,16 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
     if params is None:
         buf += struct.pack("<B", 0)
     else:
-        fusion, cycle_s, cache_on = params
-        buf += struct.pack("<BqdB", 1, fusion, cycle_s,
-                           1 if cache_on else 0)
+        fusion, cycle_s, cache_on, hier_ar, hier_ag = params
+        buf += struct.pack("<BqdBBB", 1, fusion, cycle_s,
+                           1 if cache_on else 0, 1 if hier_ar else 0,
+                           1 if hier_ag else 0)
     return bytes(buf)
 
 
 def decode_response_list(data: bytes) -> Tuple[
         List[Response], bool, List[int], List[str],
-        Optional[Tuple[int, float, bool]]]:
+        Optional[Tuple[int, float, bool, bool, bool]]]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
@@ -270,7 +274,9 @@ def decode_response_list(data: bytes) -> Tuple[
     off += 1
     params = None
     if has_params:
-        fusion, cycle_s, cache_on = struct.unpack_from("<qdB", data, off)
-        off += struct.calcsize("<qdB")
-        params = (fusion, cycle_s, bool(cache_on))
+        fusion, cycle_s, cache_on, hier_ar, hier_ag = struct.unpack_from(
+            "<qdBBB", data, off)
+        off += struct.calcsize("<qdBBB")
+        params = (fusion, cycle_s, bool(cache_on), bool(hier_ar),
+                  bool(hier_ag))
     return out, bool(shutdown), hits, resend, params

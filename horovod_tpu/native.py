@@ -78,7 +78,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
         c.POINTER(c.c_int32), c.POINTER(c.c_int32),
         c.c_double, c.c_int64, c.c_double, c.c_double, c.c_int, c.c_int64,
-        c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int, c.c_double,
+        c.c_int, c.c_int,                          # hierarchical ar/ag
+        c.c_int, c.c_int, c.c_int, c.c_int,        # autotune, tune f/c/c
+        c.c_int, c.c_int,                          # tune hier ar/ag
+        c.c_int, c.c_int, c.c_double,
         c.c_char_p, c.c_char_p, c.c_int,
     ]
     lib.hvd_create.restype = c.c_int

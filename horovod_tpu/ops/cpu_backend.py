@@ -81,19 +81,28 @@ def _chunk_bounds(n: int, parts: int) -> List[int]:
 def ring_allreduce_flat(engine, flat: np.ndarray,
                         op: ReduceOp) -> np.ndarray:
     """In-place-style ring allreduce of a flat array; returns the result."""
-    size, rank = engine.size, engine.rank
+    group = list(range(engine.size))
+    return _ring_allreduce_group(engine, flat, op, group, engine.rank)
+
+
+def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
+                          group, me: int) -> np.ndarray:
+    """Ring allreduce restricted to ``group`` (global ranks, any order);
+    ``me`` is this rank's index within it.  Same chunk walk as the C++
+    engine (RingAllreduceGroup) so mixed jobs stay bit-identical."""
+    size = len(group)
     if size == 1:
         return flat
-    right = engine._data[(rank + 1) % size]
-    left = engine._data[(rank - 1) % size]
+    right = engine._data[group[(me + 1) % size]]
+    left = engine._data[group[(me - 1) % size]]
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, size)
     chunks = [flat[bounds[i]:bounds[i + 1]].copy() for i in range(size)]
 
     # Phase 1: ring reduce-scatter.
     for step in range(size - 1):
-        send_idx = (rank - step) % size
-        recv_idx = (rank - step - 1) % size
+        send_idx = (me - step) % size
+        recv_idx = (me - step - 1) % size
         t = _send_async(right, chunks[send_idx].tobytes())
         incoming = np.frombuffer(_recv(left), dtype=dtype).copy()
         t.join()
@@ -101,14 +110,70 @@ def ring_allreduce_flat(engine, flat: np.ndarray,
 
     # Phase 2: ring allgather of the reduced chunks.
     for step in range(size - 1):
-        send_idx = (rank + 1 - step) % size
-        recv_idx = (rank - step) % size
+        send_idx = (me + 1 - step) % size
+        recv_idx = (me - step) % size
         t = _send_async(right, chunks[send_idx].tobytes())
         chunks[recv_idx] = np.frombuffer(_recv(left), dtype=dtype).copy()
         t.join()
 
-    return np.concatenate([np.atleast_1d(c) for c in chunks]) \
-        if size > 1 else flat
+    return np.concatenate([np.atleast_1d(c) for c in chunks])
+
+
+def _local_group(engine):
+    L = engine.local_size
+    return [engine.cross_rank * L + i for i in range(L)]
+
+
+def _cross_group(engine):
+    L = engine.local_size
+    return [k * L + engine.local_rank for k in range(engine.cross_size)]
+
+
+def hierarchical_allreduce_flat(engine, flat: np.ndarray,
+                                op: ReduceOp) -> np.ndarray:
+    """Two-level allreduce: local ring reduce-scatter → cross ring
+    allreduce of the owned 1/local_size slice → local ring allgather.
+
+    TPU-design parity: ``NCCLHierarchicalAllreduce``
+    (nccl_operations.cc:163-363) — the bandwidth-heavy phases ride the
+    node-local links; only 1/local_size of the bytes crosses nodes.
+    Requires the launcher's homogeneous block rank layout, checked by
+    ``engine.hierarchical_topology_ok()`` before dispatching here.
+    """
+    L = engine.local_size
+    li = engine.local_rank
+    local = _local_group(engine)
+    right = engine._data[local[(li + 1) % L]]
+    left = engine._data[local[(li - 1) % L]]
+    dtype = flat.dtype
+    bounds = _chunk_bounds(flat.size, L)
+    chunks = [flat[bounds[i]:bounds[i + 1]].copy() for i in range(L)]
+
+    # Phase 1: local ring reduce-scatter.
+    for step in range(L - 1):
+        send_idx = (li - step) % L
+        recv_idx = (li - step - 1) % L
+        t = _send_async(right, chunks[send_idx].tobytes())
+        incoming = np.frombuffer(_recv(left), dtype=dtype).copy()
+        t.join()
+        chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
+
+    # Phase 2: cross-node ring allreduce of the fully-reduced owned chunk.
+    own = (li + 1) % L
+    if chunks[own].size:
+        chunks[own] = _ring_allreduce_group(
+            engine, chunks[own], op, _cross_group(engine),
+            engine.cross_rank)
+
+    # Phase 3: local ring allgather.
+    for step in range(L - 1):
+        send_idx = (li + 1 - step) % L
+        recv_idx = (li - step) % L
+        t = _send_async(right, chunks[send_idx].tobytes())
+        chunks[recv_idx] = np.frombuffer(_recv(left), dtype=dtype).copy()
+        t.join()
+
+    return np.concatenate([np.atleast_1d(c) for c in chunks])
 
 
 def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
@@ -154,6 +219,9 @@ def allreduce(engine, entries, resp: Response):
 
     if op == ReduceOp.ADASUM:
         reduced = _adasum_flat(engine, flat)
+    elif getattr(engine, "hierarchical_allreduce", False) and \
+            engine.hierarchical_topology_ok():
+        reduced = hierarchical_allreduce_flat(engine, flat, op)
     else:
         reduced = ring_allreduce_flat(engine, flat, op)
 
@@ -174,8 +242,67 @@ def allreduce(engine, entries, resp: Response):
     return results
 
 
+def _allgather_hierarchical(engine, entries, resp: Response):
+    """Two-level allgatherv (role parity: MPIHierarchicalAllgather,
+    mpi_operations.cc:168-309 — there via a node-shared MPI window, here
+    via the node ring + a leaders-only cross ring + local fan-out).
+    Output ordering matches the flat path because the block rank layout
+    makes node blocks contiguous in global rank order."""
+    L, li = engine.local_size, engine.local_rank
+    C = engine.cross_size
+    local = _local_group(engine)
+    results = []
+    for e in entries:
+        dtype = _np_dtype(resp.tensor_type)
+        rest_shape = e.array.shape[1:] if e.array.ndim > 0 else ()
+        first_dims = resp.tensor_sizes
+
+        # Phase 1: node-local ragged ring allgatherv (raw bytes).
+        blocks: List[Optional[bytes]] = [None] * L
+        blocks[li] = np.ascontiguousarray(e.array).tobytes()
+        right = engine._data[local[(li + 1) % L]]
+        left = engine._data[local[(li - 1) % L]]
+        for step in range(L - 1):
+            send_idx = (li - step) % L
+            recv_idx = (li - step - 1) % L
+            t = _send_async(right, blocks[send_idx])
+            blocks[recv_idx] = _recv(left)
+            t.join()
+        node_block = b"".join(blocks)
+
+        if li == 0:
+            # Phase 2: leaders' ragged ring allgatherv of node blocks.
+            me = engine.cross_rank
+            nblocks: List[Optional[bytes]] = [None] * C
+            nblocks[me] = node_block
+            if C > 1:
+                nright = engine._data[((me + 1) % C) * L]
+                nleft = engine._data[((me - 1) % C) * L]
+                for step in range(C - 1):
+                    send_idx = (me - step) % C
+                    recv_idx = (me - step - 1) % C
+                    t = _send_async(nright, nblocks[send_idx])
+                    nblocks[recv_idx] = _recv(nleft)
+                    t.join()
+            full = b"".join(nblocks)
+            # Phase 3: fan the full buffer out to the rest of the node.
+            threads = [_send_async(engine._data[r], full)
+                       for r in local[1:]]
+            for t in threads:
+                t.join()
+        else:
+            full = _recv(engine._data[local[0]])
+
+        arr = np.frombuffer(full, dtype=dtype).copy()
+        results.append(arr.reshape((sum(first_dims),) + rest_shape))
+    return results
+
+
 def allgather(engine, entries, resp: Response):
     """Ragged ring allgatherv; one entry per response."""
+    if getattr(engine, "hierarchical_allgather", False) and \
+            engine.hierarchical_topology_ok():
+        return _allgather_hierarchical(engine, entries, resp)
     size, rank = engine.size, engine.rank
     results = []
     for e in entries:

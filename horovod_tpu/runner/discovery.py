@@ -32,6 +32,19 @@ class PodTopology:
     cross_size: int      # number of slices
 
 
+def block_topology_ok(rank: int, size: int, local_rank: int,
+                      local_size: int, cross_rank: int,
+                      cross_size: int) -> bool:
+    """True for a genuine two-level topology in the launcher's homogeneous
+    block rank layout (rank = cross_rank*local_size + local_rank) — the
+    precondition for the hierarchical data plane (the single shared copy
+    of this invariant; the C++ engine mirrors it in
+    ``Engine::HierarchicalTopologyOk``)."""
+    return (local_size > 1 and cross_size > 1
+            and local_size * cross_size == size
+            and rank == cross_rank * local_size + local_rank)
+
+
 def from_tpu_metadata() -> Optional[PodTopology]:
     """Build topology from TPU VM env metadata; None when not on a pod."""
     env = os.environ

@@ -32,6 +32,7 @@ from horovod_tpu.common.types import (
     StatusType,
     dtype_from_numpy,
 )
+from horovod_tpu.runner.discovery import block_topology_ok
 from horovod_tpu.runtime_py import _np_dtype
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import timeline as timeline_mod
@@ -90,7 +91,13 @@ class NativeEngine:
             1 if env_util.get_bool(env_util.STALL_CHECK_DISABLE, False)
             else 0,
             env_util.get_int(env_util.CACHE_CAPACITY, 1024),
-            *self._autotune_args(),
+            1 if env_util.get_bool(env_util.HIERARCHICAL_ALLREDUCE, False)
+            else 0,
+            1 if env_util.get_bool(env_util.HIERARCHICAL_ALLGATHER, False)
+            else 0,
+            *self._autotune_args(
+                block_topology_ok(rank, size, local_rank, local_size,
+                                  cross_rank, cross_size)),
             (env_util.get_str(env_util.TIMELINE).encode() or None)
             if rank == 0 else None,
             1 if env_util.get_bool(env_util.TIMELINE_MARK_CYCLES, False)
@@ -103,20 +110,22 @@ class NativeEngine:
         self._shutdown = False
 
     @staticmethod
-    def _autotune_args():
+    def _autotune_args(hierarchical_ok: bool = False):
         """hvd_create's autotune tail, from the shared env policy (single
         source: autotune.parameter_manager.autotune_options_from_env)."""
         from horovod_tpu.autotune.parameter_manager import (
             autotune_options_from_env,
         )
 
-        opts = autotune_options_from_env()
+        opts = autotune_options_from_env(hierarchical_ok)
         if opts is None:
-            return (0, 0, 0, 0, 0, 0, 0.0, None)
+            return (0, 0, 0, 0, 0, 0, 0, 0, 0.0, None)
         return (1,
                 1 if opts["tune_fusion"] else 0,
                 1 if opts["tune_cycle"] else 0,
                 1 if opts["tune_cache"] else 0,
+                1 if opts["tune_hier_allreduce"] else 0,
+                1 if opts["tune_hier_allgather"] else 0,
                 opts["warmup_samples"], opts["max_samples"],
                 opts["sample_duration_s"],
                 opts["log_path"].encode() if opts["log_path"] else None)

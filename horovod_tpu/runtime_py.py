@@ -266,6 +266,14 @@ class PyEngine(_EngineBase):
             env_util.STALL_SHUTDOWN_TIME, 0.0)
         self.stall_check_disable = env_util.get_bool(
             env_util.STALL_CHECK_DISABLE, False)
+        # Two-level data plane (parity: HOROVOD_HIERARCHICAL_* knobs and
+        # NCCLHierarchicalAllreduce / MPIHierarchicalAllgather).  Only
+        # effective on a genuinely hierarchical topology — see
+        # hierarchical_topology_ok().
+        self.hierarchical_allreduce = env_util.get_bool(
+            env_util.HIERARCHICAL_ALLREDUCE, False)
+        self.hierarchical_allgather = env_util.get_bool(
+            env_util.HIERARCHICAL_ALLGATHER, False)
         self.native_fallback_reason = None
 
         # request queue (tensor queue) + tensor table
@@ -305,7 +313,9 @@ class PyEngine(_EngineBase):
             from horovod_tpu.autotune import ParameterManager
 
             self._pm = ParameterManager.from_env(
-                self.fusion_threshold, self.cycle_time)
+                self.fusion_threshold, self.cycle_time,
+                self.hierarchical_allreduce, self.hierarchical_allgather,
+                hierarchical_ok=self.hierarchical_topology_ok())
         self._pending_params = None
 
         self._bootstrap(rdv_addr, rdv_port)
@@ -548,9 +558,15 @@ class PyEngine(_EngineBase):
         for p in hit_positions:
             resp = self._cache.get_by_position(p)
             if resp is None:
-                # Coherence violation — should be impossible; surface it.
-                self.log.error("cache position %d missing locally", p)
-                continue
+                # A missing position means this rank's cache diverged from
+                # the coordinator's.  Executing the remaining hits would
+                # launch a different collective sequence than the other
+                # ranks and hang the whole job — fail fast instead.
+                self.log.error(
+                    "cache coherence violation: position %d missing "
+                    "locally, aborting", p)
+                self._abort(f"cache coherence violation: position {p}")
+                return
             self._cache.touch(p)
             # Copy: _fuse_responses mutates its inputs in place, and the
             # cached Response must stay single-tensor.
@@ -611,10 +627,21 @@ class PyEngine(_EngineBase):
         return True
 
     def _apply_params(self, params) -> None:
-        fusion, cycle_s, cache_on = params
+        fusion, cycle_s, cache_on, hier_ar, hier_ag = params
         self.fusion_threshold = fusion
         self.cycle_time = cycle_s
         self._cache_classify_enabled = cache_on
+        self.hierarchical_allreduce = hier_ar
+        self.hierarchical_allgather = hier_ag
+
+    def hierarchical_topology_ok(self) -> bool:
+        """True when the two-level data plane can run: a real local/cross
+        split and the launcher's homogeneous block rank layout."""
+        from horovod_tpu.runner.discovery import block_topology_ok
+
+        return block_topology_ok(self.rank, self.size, self.local_rank,
+                                 self.local_size, self.cross_rank,
+                                 self.cross_size)
 
     # -- coordinator ----------------------------------------------------
 
@@ -708,7 +735,9 @@ class PyEngine(_EngineBase):
             params = None
             if tuned is not None:
                 params = (tuned.fusion_threshold, tuned.cycle_time_s,
-                          tuned.cache_enabled)
+                          tuned.cache_enabled,
+                          tuned.hierarchical_allreduce,
+                          tuned.hierarchical_allgather)
                 self._pending_params = None
             shared = None
             for r, s in self._ctrl_socks.items():

@@ -147,6 +147,37 @@ def scenario_adasum():
     np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
 
 
+def scenario_hier_vs_flat():
+    """Hierarchical data plane vs the flat ring: bit-identical for exact
+    dtypes (both equal the exact integer sum), fp-tolerance vs the fp64
+    oracle for floats.  Sizes are deliberately not divisible by the
+    local/cross split so the chunking edge cases run."""
+    rank, size = hvd.rank(), hvd.size()
+    for dtype in (np.int32, np.int64):
+        name = np.dtype(dtype).name
+        mk = lambda r: np.random.RandomState(100 + r).randint(
+            -1000, 1000, 257).astype(dtype)
+        out = hvd.allreduce(mk(rank), op=hvd.Sum, name=f"hf.{name}")
+        expect = sum(mk(r).astype(np.int64) for r in range(size))
+        np.testing.assert_array_equal(out.astype(np.int64), expect)
+    mkf = lambda r: np.random.RandomState(200 + r).randn(513).astype(
+        np.float32)
+    out = hvd.allreduce(mkf(rank), op=hvd.Sum, name="hf.f32")
+    oracle = np.sum([mkf(r) for r in range(size)], axis=0,
+                    dtype=np.float64)
+    np.testing.assert_allclose(out.astype(np.float64), oracle,
+                               rtol=1e-6, atol=1e-6)
+    # tiny tensor: more ranks than elements → empty chunks on some hops
+    out = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum, name="hf.tiny")
+    np.testing.assert_array_equal(out, np.full(2, float(size), np.float32))
+    # ragged allgather under hierarchical mode
+    g = np.full((rank + 2, 3), float(rank), np.float32)
+    out = hvd.allgather(g, name="hf.ag")
+    expect = np.concatenate(
+        [np.full((r + 2, 3), float(r), np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, expect)
+
+
 def scenario_join():
     rank, size = hvd.rank(), hvd.size()
     # rank r has r+1 batches; ranks keep allreducing until out of data.

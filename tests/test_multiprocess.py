@@ -20,21 +20,30 @@ WORKER = os.path.join(HERE, "eager_worker.py")
 
 
 def run_workers(scenario: str, np_: int = 2, timeout: float = 120.0,
-                extra_env=None, engine: str = "native"):
+                extra_env=None, engine: str = "native",
+                local_size: int = None):
     """engine: 'native' (C++ core), 'py' (Python engine), or 'mixed'
     (alternating per rank) — mixed works because the two engines speak the
-    same wire protocol and run identical ring algorithms."""
+    same wire protocol and run identical ring algorithms.
+
+    ``local_size``: simulate a multi-node topology (block layout, like the
+    launcher's slot allocation): rank = cross_rank*local_size+local_rank.
+    Default: one node containing all ranks."""
     server = RendezvousServer("127.0.0.1")
     port = server.start()
     procs = []
+    ls = local_size or np_
+    assert np_ % ls == 0
     try:
         for rank in range(np_):
             env = dict(os.environ)
             env.update({
                 "HVD_RANK": str(rank),
                 "HVD_SIZE": str(np_),
-                "HVD_LOCAL_RANK": str(rank),
-                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank % ls),
+                "HVD_LOCAL_SIZE": str(ls),
+                "HVD_CROSS_RANK": str(rank // ls),
+                "HVD_CROSS_SIZE": str(np_ // ls),
                 "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
                 "HVD_RENDEZVOUS_PORT": str(port),
                 "JAX_PLATFORMS": "cpu",
@@ -107,6 +116,36 @@ def test_alltoall(engine):
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 def test_adasum(engine):
     run_workers("adasum", 4, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_hierarchical_allreduce(engine):
+    # np=4 as 2 nodes × 2 local ranks; the same op-semantics scenario must
+    # pass with the two-level data plane (int dtypes exercise exact
+    # equality with the flat expectation; see also hier_vs_flat below).
+    run_workers("allreduce", 4, engine=engine, local_size=2,
+                extra_env={"HVD_HIERARCHICAL_ALLREDUCE": "1"})
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_hierarchical_allgather(engine):
+    run_workers("allgather", 4, engine=engine, local_size=2,
+                extra_env={"HVD_HIERARCHICAL_ALLGATHER": "1"})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_hierarchical_vs_flat_bitwise(engine):
+    # hier_vs_flat asserts the hierarchical result equals the flat ring's
+    # bit-for-bit for exact dtypes and to fp tolerance for floats.
+    run_workers("hier_vs_flat", 4, engine=engine, local_size=2,
+                extra_env={"HVD_HIERARCHICAL_ALLREDUCE": "1",
+                           "HVD_HIERARCHICAL_ALLGATHER": "1"})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_hierarchical_fusion(engine):
+    run_workers("fusion", 4, engine=engine, local_size=2,
+                extra_env={"HVD_HIERARCHICAL_ALLREDUCE": "1"})
 
 
 @pytest.mark.parametrize("engine", ENGINES)

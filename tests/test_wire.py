@@ -77,9 +77,9 @@ def test_response_roundtrip():
 
 def test_response_list_params_roundtrip():
     data = wire.encode_response_list(
-        [], params=(32 << 20, 0.0035, False))
+        [], params=(32 << 20, 0.0035, False, True, False))
     _, _, _, _, params = wire.decode_response_list(data)
-    assert params == (32 << 20, 0.0035, False)
+    assert params == (32 << 20, 0.0035, False, True, False)
 
 
 def test_response_shapes_roundtrip():
