@@ -103,6 +103,8 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
     def _fn(x):
         y = _engine_call(
             lambda v: _eager.allreduce(v, op=rop, name=nm), x, x.dtype)
+        # The engine flattens 0-d scalars to shape (1,); restore.
+        y = tf.reshape(y, tf.shape(x))
         y.set_shape(x.shape)
 
         def grad(dy):
@@ -149,6 +151,8 @@ def broadcast(tensor, root_rank=0, name=None):
         y = _engine_call(
             lambda v: _eager.broadcast(v, root_rank=root_rank, name=nm),
             x, x.dtype)
+        # The engine flattens 0-d scalars to shape (1,); restore.
+        y = tf.reshape(y, tf.shape(x))
         y.set_shape(x.shape)
 
         def grad(dy):
@@ -241,6 +245,12 @@ def DistributedOptimizer(optimizer, name=None,
     applied (parity: tensorflow/__init__.py:266-311 — there via
     compute_gradients; Keras 3 funnels through apply_gradients).
 
+    ``op=Adasum`` selects the delta-model wrapper (parity:
+    ``_DistributedAdasumOptimizer``, tensorflow/__init__.py:313-407):
+    the local optimizer applies its update, the parameter *deltas* are
+    combined with scale-invariant Adasum, and variables are reset to
+    ``start + adasum(deltas)``.
+
     The instance is re-classed in place (same dynamic-subclass technique
     as the reference) so restored slot state and the iteration counter
     survive — important when wrapping an optimizer loaded from a
@@ -254,6 +264,25 @@ def DistributedOptimizer(optimizer, name=None,
     base_cls = optimizer.__class__
     _op = op
     _compression = compression
+
+    if op == ReduceOp.ADASUM:
+        class _WrappedAdasum(base_cls):
+            def apply_gradients(self, grads_and_vars, *args, **kwargs):
+                gv = list(grads_and_vars)
+                tvars = [v for _, v in gv]
+                starts = [tf.identity(v) for v in tvars]
+                result = super().apply_gradients(gv, *args, **kwargs)
+                for i, (v, s) in enumerate(zip(tvars, starts)):
+                    delta = tf.convert_to_tensor(v) - s
+                    compressed, ctx = _compression.compress(delta)
+                    d = allreduce(compressed, op=ReduceOp.ADASUM,
+                                  name=f"adasum.delta.{i}")
+                    v.assign(s + _compression.decompress(d, ctx))
+                return result
+
+        _WrappedAdasum.__name__ = f"DistributedAdasum{base_cls.__name__}"
+        optimizer.__class__ = _WrappedAdasum
+        return optimizer
 
     class _Wrapped(base_cls):
         def apply_gradients(self, grads_and_vars, *args, **kwargs):

@@ -202,13 +202,76 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Delta-model Adasum optimizer (parity: torch/__init__.py:224-392).
+
+    Where the averaging optimizer combines *gradients*, Adasum's contract
+    combines the *parameter deltas* the local optimizer produced: each
+    rank applies its own ``step()``, the per-parameter deltas
+    ``p - p_start`` are reduced with the scale-invariant Adasum operation
+    (``ops/adasum.py``), and every rank resets to
+    ``p_start + adasum(deltas)``.  The starting model is broadcast from
+    rank 0 at construction so the deltas are taken from a common point.
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        if backward_passes_per_step != 1:
+            # The averaging wrapper implements this via gradient hooks;
+            # the delta model has no hook to delay — accumulate by calling
+            # backward() several times before step() instead.
+            raise ValueError(
+                "backward_passes_per_step > 1 is not supported with "
+                "op=Adasum: call loss.backward() several times before "
+                "optimizer.step() to accumulate gradients locally")
+        self._compression = compression
+        self._starting_models = {}
+        names = dict(named_parameters or [])
+        by_param = {v: k for k, v in names.items()}
+        self._adasum_names = {}
+        for i, group in enumerate(self.param_groups):
+            for j, p in enumerate(group["params"]):
+                self._adasum_names[p] = (
+                    f"adasum.delta.{by_param[p]}" if p in by_param
+                    else f"adasum.delta.{i}.{j}")
+        if size() > 1:
+            broadcast_parameters(
+                [(nm, p) for p, nm in self._adasum_names.items()],
+                root_rank=0)
+
+    def step(self, closure=None):
+        updated = [p for group in self.param_groups
+                   for p in group["params"] if p.grad is not None]
+        starts = {p: p.data.clone().detach() for p in updated}
+        loss = super(self.__class__, self).step(closure)
+        handles = []
+        for p in updated:
+            delta = p.data - starts[p]
+            compressed, ctx = self._compression.compress(delta)
+            h = allreduce_async(compressed, name=self._adasum_names[p],
+                                op=ReduceOp.ADASUM)
+            handles.append((p, h, ctx))
+        with torch.no_grad():
+            for p, h, ctx in handles:
+                d = self._compression.decompress(synchronize(h), ctx)
+                p.data.copy_(starts[p] + d)
+        return loss
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
                          op=ReduceOp.AVERAGE):
     """Wraps a torch optimizer: gradient allreduce overlaps backward;
     ``step()`` synchronizes (parity: torch/__init__.py:394-449, same
-    dynamic-subclass technique)."""
+    dynamic-subclass technique).  ``op=Adasum`` selects the delta-model
+    wrapper (parity: the op switch in the reference factory)."""
+    if op == ReduceOp.ADASUM:
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,

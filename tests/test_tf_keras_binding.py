@@ -80,6 +80,12 @@ def test_keras_fit():
     run_tf_workers("keras_fit", 2)
 
 
+def test_tf_adasum_optimizer_golden():
+    # Delta-model Adasum wrapper at 4 ranks vs the numpy VHDD oracle,
+    # through apply_gradients (ref tensorflow/__init__.py:313-407).
+    run_tf_workers("adasum_optimizer", 4)
+
+
 # -- single-process: LR callbacks + JAX-native schedules ------------------
 
 

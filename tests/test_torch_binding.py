@@ -166,3 +166,9 @@ def test_torch_join():
 @pytest.mark.parametrize("engine", ENGINES)
 def test_torch_adasum_golden(engine):
     run_torch_workers("adasum", 4, engine=engine)
+
+
+def test_torch_adasum_optimizer_golden():
+    # Delta-model _DistributedAdasumOptimizer at 4 ranks vs the numpy
+    # VHDD oracle, through optimizer.step() (ref torch/__init__.py:224-392).
+    run_torch_workers("adasum_optimizer", 4)

@@ -121,6 +121,29 @@ def scenario_keras_fit():
         np.testing.assert_allclose(gathered.numpy()[r], flat, atol=1e-5)
 
 
+def scenario_adasum_optimizer():
+    # Golden parity for the TF delta-model wrapper (ref
+    # tensorflow/__init__.py:313-407): keras SGD(lr) local delta is
+    # -lr*grad; after apply_gradients the variable must equal
+    # start + adasum_reduce_numpy([-lr*g_r]).
+    import keras
+
+    from horovod_tpu.ops.adasum import adasum_reduce_numpy
+
+    rank, size = hvd.rank(), hvd.size()
+    start = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+    v = tf.Variable(start.copy())
+    lr = 0.1
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=lr), op=hvd.Adasum)
+    grads = [np.random.RandomState(70 + r).randn(3, 4).astype(np.float32)
+             for r in range(size)]
+    opt.apply_gradients([(tf.constant(grads[rank]), v)])
+    deltas = [(-lr * g).ravel() for g in grads]
+    expect = start + adasum_reduce_numpy(deltas).reshape(start.shape)
+    np.testing.assert_allclose(v.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+
 SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
              if k.startswith("scenario_")}
 

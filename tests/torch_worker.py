@@ -184,6 +184,39 @@ def scenario_adasum():
     np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
 
 
+def scenario_adasum_optimizer():
+    # Golden parity for the delta-model optimizer (ref
+    # torch/__init__.py:224-392): with SGD(lr) the local delta is
+    # -lr*grad, so after one step every rank's params must equal
+    # start + adasum_reduce_numpy([-lr*g_r]) per the numpy VHDD oracle.
+    from horovod_tpu.ops.adasum import adasum_reduce_numpy
+
+    rank, size = hvd.rank(), hvd.size()
+    torch.manual_seed(1234)  # identical init everywhere
+    model = torch.nn.Linear(13, 1, bias=False)
+    start = model.weight.detach().numpy().copy()
+    lr = 0.1
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=lr), op=hvd.Adasum)
+    grads = [np.random.RandomState(50 + r).randn(1, 13).astype(np.float32)
+             for r in range(size)]
+    model.weight.grad = torch.from_numpy(grads[rank].copy())
+    opt.step()
+    deltas = [(-lr * g).ravel() for g in grads]
+    expect = start + adasum_reduce_numpy(deltas).reshape(start.shape)
+    np.testing.assert_allclose(model.weight.detach().numpy(), expect,
+                               rtol=1e-4, atol=1e-5)
+
+    # A second step from the now-agreed state keeps working (names reused,
+    # cache path) and stays in agreement across ranks.
+    model.weight.grad = torch.from_numpy(grads[rank].copy())
+    opt.step()
+    gathered = hvd.allgather(model.weight.detach().reshape(1, -1),
+                             name="t.adasum.opt.agree")
+    for r in range(size):
+        assert torch.allclose(gathered[r], gathered[0], atol=1e-6)
+
+
 def scenario_join():
     rank, size = hvd.rank(), hvd.size()
     for b in range(rank + 1):
