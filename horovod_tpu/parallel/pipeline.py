@@ -150,6 +150,11 @@ def pipeline_apply(params, tokens, cfg: tfm.TransformerConfig, mesh,
             return h, aux
 
         out, aux = gpipe(stage_fn, x_mb, axis="pp")
+        # gpipe sums aux over microbatches; the per-microbatch MoE
+        # load-balance statistic is scale-free (~the full-batch value), so
+        # average to keep the loss independent of the n_microbatches
+        # throughput knob.
+        aux = aux / M
         x = out.reshape(B, S, D)
         x = tfm._rmsnorm(x, params["ln_f"])
         logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
