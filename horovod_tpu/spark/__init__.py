@@ -10,9 +10,12 @@ rendezvous against the driver's HTTP server exactly like `hvdrun`
 workers do, so the whole coordination stack is shared with the plain
 launcher.
 
-Gated on the ``pyspark`` package (not shipped in this environment); the
-Estimator API (``horovod/spark/common/estimator.py``) additionally needs
-``petastorm`` for DataFrame materialization and raises accordingly.
+``run()`` is gated on the ``pyspark`` package (not shipped in this
+environment).  The Estimator API (``horovod/spark/common/estimator.py``)
+is NOT: it materializes to parquet with pyarrow and can execute through
+either the Spark barrier backend or the plain launcher
+(``spark/estimator.py``), so ``TorchEstimator``/``KerasEstimator`` run —
+and are tested — without a Spark cluster.
 """
 
 from __future__ import annotations
@@ -58,6 +61,7 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     from pyspark import BarrierTaskContext
     from pyspark.sql import SparkSession
 
+    from horovod_tpu.runner import secret as secret_mod
     from horovod_tpu.runner.http_server import RendezvousServer
     from horovod_tpu.runner.run import _routable_address
 
@@ -71,7 +75,8 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     # resolution often yields loopback on Debian-style /etc/hosts).
     addr = sc.getConf().get("spark.driver.host", None) or \
         _routable_address()
-    server = RendezvousServer(addr)
+    job_secret = secret_mod.make_secret()
+    server = RendezvousServer(addr, secret=job_secret)
     port = server.start()
     nproc = num_proc
     if verbose:
@@ -104,6 +109,7 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
             "HVD_CROSS_SIZE": str(len(cross_hosts)),
             "HVD_RENDEZVOUS_ADDR": addr,
             "HVD_RENDEZVOUS_PORT": str(port),
+            secret_mod.ENV_VAR: job_secret,
             # Stage retries must not rendezvous against a previous
             # attempt's stale addresses on the still-running server.
             "HVD_RDV_SCOPE": f"attempt{ctx.stageAttemptNumber()}",
@@ -140,26 +146,13 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
     return [result for _, result in sorted(pairs)]
 
 
-class KerasEstimator:
-    """Parity surface: horovod/spark/keras/estimator.py — fit a Keras
-    model on a Spark DataFrame.  Needs pyspark + petastorm."""
-
-    def __init__(self, *a, **kw):
-        _require_pyspark("KerasEstimator")
-        raise NotImplementedError(
-            "KerasEstimator needs petastorm-based DataFrame "
-            "materialization, which is not available in this "
-            "environment; materialize your data and call "
-            "horovod_tpu.spark.run(train_fn) instead.")
-
-
-class TorchEstimator:
-    """Parity surface: horovod/spark/torch/estimator.py."""
-
-    def __init__(self, *a, **kw):
-        _require_pyspark("TorchEstimator")
-        raise NotImplementedError(
-            "TorchEstimator needs petastorm-based DataFrame "
-            "materialization, which is not available in this "
-            "environment; materialize your data and call "
-            "horovod_tpu.spark.run(train_fn) instead.")
+from horovod_tpu.spark.estimator import (  # noqa: E402,F401
+    HorovodEstimator,
+    KerasEstimator,
+    KerasModel,
+    LocalBackend,
+    SparkBackend,
+    TorchEstimator,
+    TorchModel,
+)
+from horovod_tpu.spark.store import LocalStore, Store  # noqa: E402,F401

@@ -1,0 +1,383 @@
+"""Estimator API: fit a Keras or Torch model on a DataFrame.
+
+Role parity: ``horovod/spark/common/estimator.py`` (HorovodEstimator),
+``spark/keras/estimator.py`` and ``spark/torch/estimator.py`` +
+``torch/remote.py`` — there: materialize the DataFrame to Parquet in a
+``Store`` with Petastorm, run a remote training fn under mpirun-on-Spark,
+return a Spark Model.  Redesigned for this stack:
+
+* Materialization is plain pyarrow parquet, one shard per rank, written
+  through :class:`horovod_tpu.spark.store.Store` — works with a pyspark
+  DataFrame, a pandas DataFrame, or a dict of numpy arrays, so the whole
+  estimator path executes (and is tested) without a Spark cluster.
+* The distributed run uses ``horovod_tpu.spark.run`` (barrier mode) when
+  a Spark session is available, else the launcher's programmatic
+  ``horovod_tpu.runner.run.run`` — the estimator is backend-agnostic the
+  way the reference's ``Backend`` abstraction intended.
+* The fitted wrapper exposes ``getModel()`` / ``predict`` / ``transform``
+  (pandas in, pandas out) instead of a Spark Transformer.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.spark.store import Store
+
+
+# ---------------------------------------------------------------------------
+# data plumbing
+# ---------------------------------------------------------------------------
+
+
+def _to_pandas(df):
+    if hasattr(df, "toPandas"):          # pyspark DataFrame
+        return df.toPandas()
+    if hasattr(df, "iloc"):              # already pandas
+        return df
+    import pandas as pd
+
+    return pd.DataFrame({k: list(np.asarray(v)) for k, v in df.items()})
+
+
+def materialize(df, store: Store, run_id: str, num_shards: int) -> int:
+    """Write ``df`` as ``num_shards`` parquet shards (shard i is rank i's
+    training data).  Returns the total row count.  Parity:
+    ``util.prepare_data`` + Petastorm materialization in
+    ``spark/common/util.py``."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pdf = _to_pandas(df)
+    path = store.train_data_path(run_id)
+    store.delete(path)
+    store.makedirs(path)
+    n = len(pdf)
+    bounds = np.linspace(0, n, num_shards + 1).astype(int)
+    for i in range(num_shards):
+        shard = pdf.iloc[bounds[i]:bounds[i + 1]]
+        pq.write_table(pa.Table.from_pandas(shard),
+                       os.path.join(path, f"part-{i:05d}.parquet"))
+    return n
+
+
+def columns_to_matrix(pdf, cols: Sequence[str]) -> np.ndarray:
+    """Dense float32 matrix from DataFrame columns.  Columns holding
+    vectors (lists/arrays) are stacked; scalars become width-1 features,
+    matching the reference's flattening of Spark vector columns."""
+    parts = []
+    for c in cols:
+        col = pdf[c].to_numpy()
+        if len(col) and isinstance(col[0], (list, np.ndarray)):
+            parts.append(np.stack([np.asarray(v) for v in col]))
+        else:
+            parts.append(col.reshape(-1, 1))
+    return np.concatenate(parts, axis=1).astype(np.float32)
+
+
+def read_shard(store: Store, run_id: str, rank: int, size: int,
+               feature_cols: Sequence[str], label_cols: Sequence[str]):
+    """Load this rank's shard(s) back as dense float32 arrays."""
+    import pyarrow.parquet as pq
+
+    paths = store.shard_paths(run_id)
+    mine = paths[rank::size] if len(paths) != size else [paths[rank]]
+    if not mine:
+        raise ValueError(
+            f"rank {rank}: no training shard — {len(paths)} shard(s) were "
+            f"materialized but the job has {size} ranks; set the "
+            f"estimator's num_proc to the actual world size")
+
+    frames = [pq.read_table(p).to_pandas() for p in mine]
+    import pandas as pd
+
+    pdf = pd.concat(frames) if len(frames) > 1 else frames[0]
+    return columns_to_matrix(pdf, feature_cols), \
+        columns_to_matrix(pdf, label_cols)
+
+
+# ---------------------------------------------------------------------------
+# backends
+# ---------------------------------------------------------------------------
+
+
+class LocalBackend:
+    """Launcher-backed execution (programmatic run-func) — the default in
+    environments without a Spark session."""
+
+    def __init__(self, num_proc: int):
+        self.num_proc = num_proc
+
+    def run(self, fn: Callable) -> List[Any]:
+        from horovod_tpu.runner.run import run as run_func
+
+        return run_func(fn, np=self.num_proc)
+
+
+class SparkBackend:
+    """Barrier-mode execution via ``horovod_tpu.spark.run`` (parity:
+    spark/common/backend.py SparkBackend)."""
+
+    def __init__(self, num_proc: Optional[int] = None):
+        self.num_proc = num_proc
+
+    def run(self, fn: Callable) -> List[Any]:
+        from horovod_tpu.spark import run as spark_run
+
+        return spark_run(fn, num_proc=self.num_proc)
+
+
+def default_backend(num_proc: int):
+    try:
+        from pyspark.sql import SparkSession
+
+        if SparkSession.getActiveSession() is not None:
+            return SparkBackend(num_proc)
+    except Exception:
+        pass
+    return LocalBackend(num_proc)
+
+
+# ---------------------------------------------------------------------------
+# base estimator
+# ---------------------------------------------------------------------------
+
+
+class HorovodEstimator:
+    """Shared fit() skeleton (parity: spark/common/estimator.py:27):
+    materialize → distributed train fn → collect rank-0 artifacts →
+    return a fitted model wrapper."""
+
+    def __init__(self, *, feature_cols=("features",), label_cols=("label",),
+                 batch_size=32, epochs=1, num_proc=2, store=None,
+                 backend=None, run_id=None, verbose=1, seed=1234):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or Store.create(
+            os.path.join(os.getcwd(), ".horovod_tpu_store"))
+        self.backend = backend
+        self.run_id = run_id
+        self.verbose = verbose
+        self.seed = seed
+
+    def _fit(self, df, train_fn_builder) -> Dict[str, Any]:
+        run_id = self.run_id or f"run-{uuid.uuid4().hex[:8]}"
+        self._last_run_id = run_id
+        materialize(df, self.store, run_id, self.num_proc)
+        backend = self.backend or default_backend(self.num_proc)
+        results = backend.run(train_fn_builder(run_id))
+        arts = next(r for r in results if r is not None)
+        return arts
+
+
+# ---------------------------------------------------------------------------
+# torch
+# ---------------------------------------------------------------------------
+
+
+class TorchEstimator(HorovodEstimator):
+    """Parity: ``horovod/spark/torch/estimator.py`` + ``torch/remote.py``.
+
+    ``model``: a ``torch.nn.Module``; ``optimizer``: an instance (rebuilt
+    per worker from its class + defaults, like the reference's optimizer
+    serialization) or a factory ``params -> Optimizer``; ``loss``: a
+    callable ``(pred, target) -> scalar tensor``.
+    """
+
+    def __init__(self, model, optimizer=None, loss=None, **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+
+    def fit(self, df) -> "TorchModel":
+        import torch
+
+        model = self.model
+        loss_fn = self.loss or torch.nn.MSELoss()
+        opt = self.optimizer
+        if opt is None:
+            opt_builder = lambda ps: torch.optim.SGD(ps, lr=0.01)  # noqa: E731
+        elif callable(opt) and not isinstance(opt, torch.optim.Optimizer):
+            opt_builder = opt
+        else:
+            opt_cls, opt_defaults = opt.__class__, dict(opt.defaults)
+            opt_builder = lambda ps: opt_cls(ps, **opt_defaults)  # noqa: E731
+        store, feature_cols, label_cols = (
+            self.store, self.feature_cols, self.label_cols)
+        batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
+
+        def build(run_id):
+            def _train():
+                import torch
+
+                import horovod_tpu.torch as hvd
+
+                rank, size = hvd.rank(), hvd.size()
+                X, y = read_shard(store, run_id, rank, size,
+                                  feature_cols, label_cols)
+                local = copy.deepcopy(model)
+                dist_opt = hvd.DistributedOptimizer(
+                    opt_builder(local.parameters()),
+                    named_parameters=local.named_parameters())
+                hvd.broadcast_parameters(local.state_dict(), root_rank=0)
+                rs = np.random.RandomState(seed + rank)
+                history = []
+                for _epoch in range(epochs):
+                    perm = rs.permutation(len(X))
+                    total, nb = 0.0, 0
+                    for i in range(0, len(X), batch_size):
+                        idx = perm[i:i + batch_size]
+                        xb = torch.from_numpy(X[idx])
+                        yb = torch.from_numpy(y[idx])
+                        dist_opt.zero_grad()
+                        out = local(xb)
+                        l = loss_fn(out, yb)
+                        l.backward()
+                        dist_opt.step()
+                        total += float(l.detach())
+                        nb += 1
+                    avg = float(hvd.allreduce(
+                        torch.tensor([total / max(nb, 1)]),
+                        op=hvd.Average, name=f"est.loss.{_epoch}")[0])
+                    history.append(avg)
+                if rank == 0:
+                    store.makedirs(store.run_path(run_id))
+                    torch.save(local.state_dict(),
+                               store.checkpoint_path(run_id) + ".pt")
+                    return {"state_dict": {
+                        k: v.detach().cpu().numpy()
+                        for k, v in local.state_dict().items()},
+                        "history": history}
+                return None
+
+            return _train
+
+        arts = self._fit(df, build)
+        fitted = copy.deepcopy(model)
+        fitted.load_state_dict(
+            {k: __import__("torch").from_numpy(np.asarray(v))
+             for k, v in arts["state_dict"].items()})
+        return TorchModel(fitted, self.feature_cols, self.label_cols,
+                          history=arts["history"],
+                          run_id=self._last_run_id)
+
+
+class _FittedModel:
+    """Shared fitted-model surface (parity role: the Spark Transformer
+    returned by estimator.fit — pandas in/out instead of Spark
+    DataFrames)."""
+
+    def __init__(self, model, feature_cols, label_cols, history=None,
+                 run_id=None):
+        self._model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.history = history
+        self.run_id = run_id
+
+    def getModel(self):
+        return self._model
+
+    def transform(self, df):
+        pdf = _to_pandas(df).copy()
+        pred = self.predict(columns_to_matrix(pdf, self.feature_cols))
+        for j, c in enumerate(self.label_cols):
+            pdf[f"{c}__output"] = list(pred[:, j] if pred.ndim > 1
+                                       else pred)
+        return pdf
+
+
+class TorchModel(_FittedModel):
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        import torch
+
+        with torch.no_grad():
+            return self._model(
+                torch.from_numpy(np.asarray(X, np.float32))).numpy()
+
+
+# ---------------------------------------------------------------------------
+# keras
+# ---------------------------------------------------------------------------
+
+
+class KerasEstimator(HorovodEstimator):
+    """Parity: ``horovod/spark/keras/estimator.py`` — the model travels as
+    architecture JSON + weights (the reference serializes the compiled
+    model the same way, keras/util.py), the optimizer as its keras config.
+    """
+
+    def __init__(self, model, optimizer=None, loss="mse", metrics=(),
+                 **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = list(metrics)
+
+    def fit(self, df) -> "KerasModel":
+        import keras
+
+        model_json = self.model.to_json()
+        weights = self.model.get_weights()
+        opt_cfg = keras.optimizers.serialize(
+            self.optimizer or keras.optimizers.SGD(learning_rate=0.01))
+        loss, metrics = self.loss, self.metrics
+        store, feature_cols, label_cols = (
+            self.store, self.feature_cols, self.label_cols)
+        batch_size, epochs = self.batch_size, self.epochs
+
+        def build(run_id):
+            def _train():
+                import keras
+
+                import horovod_tpu.keras as hvd_keras
+                import horovod_tpu.tensorflow as hvd
+
+                rank, size = hvd.rank(), hvd.size()
+                X, y = read_shard(store, run_id, rank, size,
+                                  feature_cols, label_cols)
+                local = keras.models.model_from_json(model_json)
+                local.set_weights(weights)
+                opt = hvd_keras.DistributedOptimizer(
+                    keras.optimizers.deserialize(copy.deepcopy(opt_cfg)))
+                local.compile(optimizer=opt, loss=loss, metrics=metrics,
+                              run_eagerly=True)
+                hist = local.fit(
+                    X, y, batch_size=batch_size, epochs=epochs, verbose=0,
+                    callbacks=[
+                        hvd_keras.callbacks
+                        .BroadcastGlobalVariablesCallback(0),
+                        hvd_keras.callbacks.MetricAverageCallback(),
+                    ])
+                if rank == 0:
+                    store.makedirs(store.run_path(run_id))
+                    local.save(store.checkpoint_path(run_id) + ".keras")
+                    return {"weights": local.get_weights(),
+                            "history": {k: [float(x) for x in v]
+                                        for k, v in hist.history.items()}}
+                return None
+
+            return _train
+
+        arts = self._fit(df, build)
+        fitted = keras.models.model_from_json(model_json)
+        fitted.set_weights(arts["weights"])
+        return KerasModel(fitted, self.feature_cols, self.label_cols,
+                          history=arts["history"],
+                          run_id=self._last_run_id)
+
+
+class KerasModel(_FittedModel):
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return np.asarray(self._model.predict(
+            np.asarray(X, np.float32), verbose=0))

@@ -1,0 +1,73 @@
+"""Training artifact stores for the estimator API.
+
+Role parity: ``horovod/spark/common/store.py`` (LocalStore/HDFSStore —
+there a filesystem abstraction over train-data, runs, and checkpoints
+materialized with Petastorm).  Redesigned: shards are plain parquet files
+written with pyarrow — no Petastorm dependency — and the same store serves
+a pyspark DataFrame, a pandas DataFrame, or a dict of numpy arrays, so the
+estimators are fully executable without a Spark cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Filesystem layout for one estimator workspace:
+
+    ``<prefix>/intermediate_train_data/<run_id>/part-NNNNN.parquet``
+    ``<prefix>/runs/<run_id>/checkpoint.*``
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = os.path.abspath(prefix_path)
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Parity: ``Store.create`` picks the backend by URL scheme; only
+        local paths exist here (HDFS has no TPU-pod analog — pods mount
+        GCS/NFS as local paths)."""
+        return LocalStore(prefix_path)
+
+    # -- layout ----------------------------------------------------------
+
+    def train_data_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "intermediate_train_data",
+                            run_id)
+
+    def run_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, "runs", run_id)
+
+    def checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.run_path(run_id), "checkpoint")
+
+    def logs_path(self, run_id: str) -> str:
+        return os.path.join(self.run_path(run_id), "logs")
+
+    # -- fs ops ----------------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def shard_paths(self, run_id: str):
+        d = self.train_data_path(run_id)
+        if not os.path.isdir(d):
+            return []
+        return sorted(os.path.join(d, f) for f in os.listdir(d)
+                      if f.endswith(".parquet"))
+
+
+class LocalStore(Store):
+    """Local-filesystem store (parity: spark/common/store.py LocalStore)."""
