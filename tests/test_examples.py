@@ -61,6 +61,27 @@ def test_jax_synthetic_benchmark_2proc_fp16():
     assert "Total img/sec on 2 device(s)" in out
 
 
+def test_tensorflow2_mnist_2proc():
+    pytest.importorskip("tensorflow")
+    out = run_example("tensorflow2_mnist.py", 2,
+                      ["--steps", "20", "--batch-size", "16"],
+                      timeout=420)
+    assert "loss" in out
+    assert "images/sec" in out
+
+
+def test_tensorflow2_synthetic_benchmark_2proc_fp16():
+    pytest.importorskip("tensorflow")
+    out = run_example(
+        "tensorflow2_synthetic_benchmark.py", 2,
+        ["--model", "tiny", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--fp16-allreduce"],
+        timeout=420)
+    assert "Img/sec per device" in out
+    assert "Total img/sec on 2 device(s)" in out
+
+
 def test_pytorch_mnist_2proc():
     pytest.importorskip("torch")
     out = run_example(
