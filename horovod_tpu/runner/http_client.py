@@ -1,46 +1,59 @@
 """KV client for the rendezvous server.
 
 Parity: ``horovod/run/http/http_client.py`` (read_data_from_kvstore /
-put_data_into_kvstore).
+put_data_into_kvstore), plus HMAC request signing against the launcher's
+job secret (``run/common/util/secret.py`` pattern).  The secret defaults
+to the ``HVD_SECRET_KEY`` environment variable — the channel the launcher
+ships it to workers on — so every existing call site signs automatically
+when a secret is in play.
 """
 
 from __future__ import annotations
 
+import os
 import socket
 import time
 import urllib.error
 import urllib.request
 from typing import Optional
 
+from horovod_tpu.runner import secret as secret_mod
+
 
 class KVClient:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 secret: Optional[str] = None):
         self.host = host
         self.port = port
+        self.secret = (secret if secret is not None
+                       else os.environ.get(secret_mod.ENV_VAR) or None)
 
     def _url(self, key: str) -> str:
         return f"http://{self.host}:{self.port}/kv/{key}"
 
+    def _request(self, key: str, method: str, body: Optional[bytes] = None):
+        req = urllib.request.Request(self._url(key), data=body,
+                                     method=method)
+        if self.secret is not None:
+            req.add_header(secret_mod.HEADER, secret_mod.sign(
+                self.secret, method, f"/kv/{key}", body or b""))
+        return req
+
     def put(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode("utf-8")
-        req = urllib.request.Request(
-            self._url(key), data=value, method="PUT")
-        with urllib.request.urlopen(req, timeout=10):
+        with urllib.request.urlopen(self._request(key, "PUT", value),
+                                    timeout=10):
             pass
 
     def get(self, key: str) -> Optional[str]:
-        try:
-            with urllib.request.urlopen(self._url(key), timeout=10) as r:
-                return r.read().decode("utf-8")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        b = self.get_bytes(key)
+        return None if b is None else b.decode("utf-8")
 
     def get_bytes(self, key: str) -> Optional[bytes]:
         try:
-            with urllib.request.urlopen(self._url(key), timeout=10) as r:
+            with urllib.request.urlopen(self._request(key, "GET"),
+                                        timeout=10) as r:
                 return r.read()
         except urllib.error.HTTPError as e:
             if e.code == 404:
@@ -48,8 +61,8 @@ class KVClient:
             raise
 
     def delete(self, key: str) -> None:
-        req = urllib.request.Request(self._url(key), method="DELETE")
-        with urllib.request.urlopen(req, timeout=10):
+        with urllib.request.urlopen(self._request(key, "DELETE"),
+                                    timeout=10):
             pass
 
     def wait_get(self, key: str, timeout: float = 60.0,

@@ -6,6 +6,12 @@ exchange addresses at startup and to return run-function results).
 
 Protocol: ``PUT /kv/<key>`` stores the body; ``GET /kv/<key>`` returns it or
 404; ``DELETE /kv/<key>`` removes it; ``GET /health`` returns ``ok``.
+
+When the server holds a job secret (parity: ``run/common/util/secret.py``
+HMAC framing), every ``/kv/`` request must carry a valid
+``X-HVD-Auth: HMAC-SHA256(method, path, body)`` header or it is rejected
+with 403 — an unauthenticated client on the network can neither read nor
+poison rendezvous state.
 """
 
 from __future__ import annotations
@@ -13,6 +19,8 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+
+from horovod_tpu.runner import secret as secret_mod
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -24,6 +32,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _store(self) -> Dict[str, bytes]:
         return self.server.kv_store  # type: ignore[attr-defined]
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        secret = self.server.kv_secret  # type: ignore[attr-defined]
+        if secret is None:
+            return True
+        return secret_mod.verify(
+            secret, self.command, self.path, body,
+            self.headers.get(secret_mod.HEADER, ""))
+
+    def _reject(self):
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_GET(self):
         if self.path == "/health":
             body = b"ok"
@@ -31,6 +52,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if not self._authorized():
+            self._reject()
             return
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         with self.server.kv_lock:  # type: ignore[attr-defined]
@@ -49,6 +73,9 @@ class _Handler(BaseHTTPRequestHandler):
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         n = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(n)
+        if not self._authorized(body):
+            self._reject()
+            return
         if key:
             with self.server.kv_lock:  # type: ignore[attr-defined]
                 self._store()[key] = body
@@ -57,6 +84,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._authorized():
+            self._reject()
+            return
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self._store().pop(key, None)
@@ -66,12 +96,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class RendezvousServer:
-    """Threaded KV server; start() returns the bound port."""
+    """Threaded KV server; start() returns the bound port.
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    ``secret``: when given, requests must be HMAC-signed (see module
+    docstring); ``None`` (default) keeps the open behavior for loopback
+    test fixtures."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 secret: Optional[str] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.kv_store = {}  # type: ignore[attr-defined]
         self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.kv_secret = secret  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property

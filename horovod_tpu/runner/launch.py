@@ -23,6 +23,35 @@ from horovod_tpu.runner.hosts import SlotInfo
 
 _LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
 
+# Env vars that must never appear on a (ps-visible) remote command line;
+# they are delivered over the ssh process's stdin instead.
+SENSITIVE_ENV = ("HVD_SECRET_KEY",)
+
+
+def _remote_command(env: Dict[str, str], command: Sequence[str]):
+    """Build the ssh remote command string.
+
+    Returns ``(remote, stdin_payload)``.  Plain ``HVD_*``-family vars are
+    inlined as exports; sensitive ones (``SENSITIVE_ENV``) are read from
+    stdin with ``read -rs`` (silent — no pty echo into the captured
+    output) so secrets never hit argv, which any local user could read
+    via ``ps``/procfs."""
+    sensitive = [(k, env[k]) for k in SENSITIVE_ENV if k in env]
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items()
+        if k.startswith(("HVD_", "JAX_", "XLA_", "PYTHON"))
+        and k not in SENSITIVE_ENV)
+    inner = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    if not sensitive:
+        return inner, None
+    reads = "; ".join(f"IFS= read -rs {k} && export {k}"
+                      for k, _ in sensitive)
+    # bash -c: `read -s` is a bash-ism; the user's login shell may be sh.
+    remote = "bash -c " + shlex.quote(f"{reads}; {inner}")
+    payload = "".join(v + "\n" for _, v in sensitive)
+    return remote, payload
+
 
 def is_local(hostname: str) -> bool:
     import socket
@@ -108,6 +137,7 @@ def launch_workers(
 
     for slot in slots:
         env = worker_env(slot, rdv_addr, rdv_port, env_extra)
+        stdin_payload = None
         if is_local(slot.hostname):
             argv = list(command)
             popen_env = env
@@ -121,17 +151,20 @@ def launch_workers(
             if ssh_identity_file:
                 ssh_cmd += ["-i", ssh_identity_file]
             # Only HVD_* vars cross the ssh boundary (the reference passes
-            # an explicit env list too, mpi_run.py -x).
-            exports = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in env.items()
-                if k.startswith(("HVD_", "JAX_", "XLA_", "PYTHON")))
-            remote = f"cd {shlex.quote(os.getcwd())} && {exports} " + \
-                " ".join(shlex.quote(c) for c in command)
+            # an explicit env list too, mpi_run.py -x); secrets go over
+            # stdin, never argv.
+            remote, stdin_payload = _remote_command(env, command)
             argv = ssh_cmd + [slot.hostname, remote]
             popen_env = dict(os.environ)
         proc = subprocess.Popen(
-            argv, env=popen_env, stdout=subprocess.PIPE,
+            argv, env=popen_env,
+            stdin=subprocess.PIPE if stdin_payload else None,
+            stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, start_new_session=True)
+        if stdin_payload:
+            proc.stdin.write(stdin_payload.encode())
+            proc.stdin.flush()
+            proc.stdin.close()
         procs.append(proc)
         t = threading.Thread(target=_stream,
                              args=(proc, slot.rank, output, prefix_output),

@@ -27,6 +27,7 @@ from horovod_tpu.runner.hosts import allocate, parse_hostfile, parse_hosts
 from horovod_tpu.runner.http_client import KVClient
 from horovod_tpu.runner.http_server import RendezvousServer
 from horovod_tpu.runner.launch import launch_workers
+from horovod_tpu.runner import secret as secret_mod
 from horovod_tpu.version import __version__
 
 
@@ -46,8 +47,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssh-port", type=int, dest="ssh_port")
     p.add_argument("--ssh-identity-file", dest="ssh_identity_file")
     p.add_argument("--network-interface", dest="nics",
-                   help="accepted for CLI parity; address discovery is "
-                        "automatic via the rendezvous route")
+                   help="comma-separated NIC name(s); the rendezvous "
+                        "binds to and advertises the first one that "
+                        "resolves (default: automatic via the default "
+                        "route)")
     p.add_argument("--start-timeout", type=int, default=120,
                    dest="start_timeout")
     p.add_argument("--disable-cache", action="store_true",
@@ -121,12 +124,20 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     env_extra["HVD_START_TIMEOUT"] = str(args.start_timeout)
 
-    server = RendezvousServer()
+    # Per-job secret: signs every rendezvous KV request (parity:
+    # run/common/util/secret.py); workers receive it via env.
+    job_secret = secret_mod.make_secret()
+    env_extra[secret_mod.ENV_VAR] = job_secret
+
+    nic_addr = interface_address_any(args.nics) if args.nics else None
+    server = RendezvousServer(host=nic_addr or "0.0.0.0",
+                              secret=job_secret)
     port = server.start()
     # Workers reach the rendezvous at this host; for multi-host jobs they
     # need a routable address, not loopback.
     multi_host = any(not _is_local(s.hostname) for s in slots)
-    addr = _routable_address() if multi_host else "127.0.0.1"
+    addr = nic_addr or (_routable_address() if multi_host
+                        else "127.0.0.1")
     output = None
     if args.output_filename:
         output = open(args.output_filename, "w")
@@ -163,6 +174,40 @@ def _routable_address() -> str:
         s.close()
 
 
+def interface_address(ifname: str) -> Optional[str]:
+    """IPv4 address of a named interface (SIOCGIFADDR ioctl — stdlib only;
+    the reference resolves NICs with psutil + a task-service ring probe,
+    run/driver/driver_service.py:128-198)."""
+    import fcntl
+    import socket
+    import struct
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        packed = struct.pack("256s", ifname.strip().encode()[:15])
+        addr = fcntl.ioctl(s.fileno(), 0x8915, packed)[20:24]  # SIOCGIFADDR
+        return socket.inet_ntoa(addr)
+    except OSError:
+        return None
+    finally:
+        s.close()
+
+
+def interface_address_any(nics: str) -> Optional[str]:
+    """First resolvable address from a comma-separated NIC list; raises
+    if the user named interfaces and none of them resolve (silently
+    falling back would rendezvous on the wrong network)."""
+    names = [n for n in (nics or "").split(",") if n.strip()]
+    for n in names:
+        addr = interface_address(n)
+        if addr:
+            return addr
+    if names:
+        raise ValueError(
+            f"--network-interface: none of {names} has an IPv4 address")
+    return None
+
+
 # ---------------------------------------------------------------------------
 # programmatic run-func mode
 # ---------------------------------------------------------------------------
@@ -191,16 +236,18 @@ def run(
         host_list = parse_hosts(f"localhost:{np}")
     slots = allocate(host_list, np)
 
-    server = RendezvousServer()
+    job_secret = secret_mod.make_secret()
+    server = RendezvousServer(secret=job_secret)
     port = server.start()
     payload = cloudpickle.dumps((fn, args, kwargs or {}))
     multi_host = any(not _is_local(s.hostname) for s in slots)
     addr = _routable_address() if multi_host else "127.0.0.1"
-    kv = KVClient("127.0.0.1", port)
+    kv = KVClient("127.0.0.1", port, secret=job_secret)
     kv.put("runfunc/fn", payload)
     try:
         env_extra = dict(env or {})
         env_extra.setdefault("HVD_START_TIMEOUT", str(start_timeout))
+        env_extra[secret_mod.ENV_VAR] = job_secret
         launch_failure = None
         try:
             launch_workers(

@@ -154,3 +154,94 @@ def test_basics_uses_tpu_metadata(monkeypatch):
     monkeypatch.setenv("MEGASCALE_NUM_SLICES", "1")
     r = basics._discover(None, None, None, None, None, None)
     assert r == (1, 2, 1, 2, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous HMAC auth + NIC selection
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_hmac_auth(monkeypatch):
+    import urllib.error
+    import urllib.request
+
+    import pytest
+
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.http_client import KVClient
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    monkeypatch.delenv(secret_mod.ENV_VAR, raising=False)
+    s = secret_mod.make_secret()
+    server = RendezvousServer("127.0.0.1", secret=s)
+    port = server.start()
+    try:
+        good = KVClient("127.0.0.1", port, secret=s)
+        good.put("k", "v")
+        assert good.get("k") == "v"
+
+        # unauthenticated client: rejected
+        bad = KVClient("127.0.0.1", port)
+        assert bad.secret is None
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            bad.get("k")
+        assert ei.value.code == 403
+
+        # wrong secret: rejected for both read and write
+        evil = KVClient("127.0.0.1", port, secret="deadbeef")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            evil.put("k", "poison")
+        assert ei.value.code == 403
+        assert good.get("k") == "v"  # store unchanged
+
+        # tampered body: signature valid for different content → rejected
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/kv/k", data=b"tampered",
+            method="PUT")
+        req.add_header(secret_mod.HEADER,
+                       secret_mod.sign(s, "PUT", "/kv/k", b"original"))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+        assert good.get("k") == "v"
+
+        # /health stays open (load balancer probes don't hold the secret)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5) as r:
+            assert r.read() == b"ok"
+    finally:
+        server.stop()
+
+
+def test_interface_address():
+    import pytest
+
+    from horovod_tpu.runner.run import (
+        interface_address,
+        interface_address_any,
+    )
+
+    assert interface_address("lo") == "127.0.0.1"
+    assert interface_address("definitely-not-a-nic") is None
+    assert interface_address_any("definitely-not-a-nic,lo") == "127.0.0.1"
+    assert interface_address_any("") is None
+    with pytest.raises(ValueError, match="network-interface"):
+        interface_address_any("definitely-not-a-nic")
+
+
+def test_remote_command_keeps_secret_off_argv():
+    from horovod_tpu.runner.launch import _remote_command
+
+    env = {"HVD_RANK": "0", "HVD_SECRET_KEY": "s3cr3t",
+           "JAX_PLATFORMS": "cpu", "HOME": "/root"}
+    remote, payload = _remote_command(env, ["python", "train.py"])
+    assert "s3cr3t" not in remote
+    assert "HVD_SECRET_KEY" in remote       # the read/export preamble
+    assert "read -rs" in remote
+    assert payload == "s3cr3t\n"
+    assert "HVD_RANK=0" in remote
+    assert "HOME" not in remote             # only HVD_/JAX_/XLA_/PYTHON*
+
+    # no secret → plain command, nothing on stdin
+    remote, payload = _remote_command({"HVD_RANK": "1"}, ["prog"])
+    assert payload is None and "read" not in remote
