@@ -215,16 +215,9 @@ def alltoall(x, splits=None, axis: str = "dp"):
     if splits is None:
         return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
                               tiled=True)
-    n = lax.axis_size(axis)
-    counts = jnp.asarray(splits, jnp.int32)
-    all_counts = lax.all_gather(counts, axis, axis=0)  # [n, n]
-    gathered = lax.all_gather(x, axis, axis=0)  # [n, dim0, ...]
-    me = lax.axis_index(axis)
-    starts = jnp.cumsum(all_counts, axis=1) - all_counts  # row r: offsets
-    # Build output by concatenating, for each src rank r, the slice of its
-    # data destined for us.  Sizes are data-dependent → fall back to a mask
-    # + static max size; callers needing ragged alltoall should prefer the
-    # eager path.
+    # Ragged output sizes are data-dependent, which XLA's static-shape
+    # model cannot express without padding every segment to a max size —
+    # the eager engine (which negotiates sizes) is the supported path.
     raise NotImplementedError(
         "ragged in-graph alltoall is not supported; use equal splits "
         "in-graph or horovod_tpu.alltoall (eager) for ragged splits")

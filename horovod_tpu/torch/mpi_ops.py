@@ -38,11 +38,13 @@ Product = ReduceOp.PRODUCT
 
 
 def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
-    if tensor.device.type != "cpu":
-        raise ValueError(
-            "horovod_tpu.torch eager collectives operate on CPU tensors; "
-            f"got device {tensor.device}")
     t = tensor.detach()
+    if t.device.type != "cpu":
+        # Host staging for device tensors — the analog of the reference's
+        # *CudaOnCPU op variants (torch/mpi_ops_v2.cc): copy to host,
+        # run the collective there, and finalize() moves results back to
+        # tensor.device.
+        t = t.to("cpu")
     if not t.is_contiguous():
         t = t.contiguous()
     return np.ascontiguousarray(t.numpy())
@@ -73,7 +75,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 
     def finalize(result):
         return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
-            .to(tensor.dtype)
+            .to(tensor.dtype).to(tensor.device)
 
     return _register(h, finalize)
 
@@ -88,6 +90,7 @@ def allreduce_async_(tensor, average=None, name=None, op=None,
         prescale=prescale_factor, postscale=postscale_factor)
 
     def finalize(result):
+        # copy_ performs the host->device transfer itself; no pre-staging.
         out = torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
             .to(tensor.dtype)
         with torch.no_grad():
@@ -164,7 +167,7 @@ def allgather_async(tensor, name=None) -> int:
         out = torch.from_numpy(np.asarray(result))
         if tail_shape:
             out = out.reshape(-1, *tail_shape)
-        return out.to(tensor.dtype)
+        return out.to(tensor.dtype).to(tensor.device)
 
     return _register(h, finalize)
 
@@ -209,7 +212,7 @@ def broadcast_async(tensor, root_rank, name=None) -> int:
 
     def finalize(result):
         return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
-            .to(tensor.dtype)
+            .to(tensor.dtype).to(tensor.device)
 
     return _register(h, finalize)
 
@@ -220,6 +223,7 @@ def broadcast_async_(tensor, root_rank, name=None) -> int:
         _auto_name("torch.broadcast", name), arr, root_rank=root_rank)
 
     def finalize(result):
+        # copy_ performs the host->device transfer itself; no pre-staging.
         out = torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
             .to(tensor.dtype)
         with torch.no_grad():
@@ -279,7 +283,7 @@ def alltoall_async(tensor, splits=None, name=None) -> int:
         out = torch.from_numpy(np.asarray(data))
         if tail_shape:
             out = out.reshape(-1, *tail_shape)
-        out = out.to(tensor.dtype)
+        out = out.to(tensor.dtype).to(tensor.device)
         if not want_splits:
             return out
         return out, torch.tensor(list(recv_splits), dtype=torch.int64)
