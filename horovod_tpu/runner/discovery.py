@@ -85,5 +85,57 @@ def from_jax_distributed() -> Optional[PodTopology]:
                        cross_rank=r, cross_size=n)
 
 
+# MPI-launcher env schemas: (rank, size, local_rank, local_size) names.
+# Lets `hvd.init()` work under mpirun / srun / jsrun with no HVD_* env —
+# the reference gets this from MPI_Init; we read the launcher's env.
+_MPI_SCHEMAS = (
+    ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+     "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"),
+    # IBM JSM (jsrun on LSF/Summit) namespace exports.
+    ("JSM_NAMESPACE_RANK", "JSM_NAMESPACE_SIZE",
+     "JSM_NAMESPACE_LOCAL_RANK", "JSM_NAMESPACE_LOCAL_SIZE"),
+    ("PMIX_RANK", "PMIX_SIZE", "PMIX_LOCAL_RANK", "PMIX_LOCAL_SIZE"),
+    ("PMI_RANK", "PMI_SIZE", None, None),
+    ("SLURM_PROCID", "SLURM_NTASKS", "SLURM_LOCALID",
+     "SLURM_NTASKS_PER_NODE"),
+)
+
+
+def from_mpi_env() -> Optional[PodTopology]:
+    """Topology from an MPI-style launcher's environment (Open MPI /
+    PMIx / PMI / Slurm).  None when not launched that way."""
+    env = os.environ
+    for rank_k, size_k, lrank_k, lsize_k in _MPI_SCHEMAS:
+        if rank_k not in env or size_k not in env:
+            continue
+        try:
+            rank = int(env[rank_k])
+            size = int(env[size_k])
+            local_rank = int(env[lrank_k]) if lrank_k and lrank_k in env \
+                else 0
+            local_size = int(env[lsize_k]) if lsize_k and lsize_k in env \
+                else 1
+        except ValueError:
+            continue
+        if size <= 0:
+            continue
+        cross_rank = rank // local_size if local_size > 0 else 0
+        # The hierarchical data plane assumes the block rank layout;
+        # launchers mapping by node (mpirun --map-by node) violate it, and
+        # ranks must not *disagree* about hierarchy — degrade to a flat
+        # local topology unless the layout verifiably holds.
+        if (local_size <= 0 or size % local_size
+                or rank != cross_rank * local_size + local_rank):
+            local_rank, local_size = 0, 1
+            cross_rank = rank
+        return PodTopology(
+            rank=rank, size=size,
+            local_rank=local_rank, local_size=local_size,
+            cross_rank=cross_rank,
+            cross_size=size // local_size,
+        )
+    return None
+
+
 def discover() -> Optional[PodTopology]:
-    return from_tpu_metadata() or from_jax_distributed()
+    return from_tpu_metadata() or from_mpi_env() or from_jax_distributed()

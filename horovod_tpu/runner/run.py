@@ -51,6 +51,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "binds to and advertises the first one that "
                         "resolves (default: automatic via the default "
                         "route)")
+    p.add_argument("--launcher", choices=["spawn", "jsrun"],
+                   default="spawn",
+                   help="spawn: local subprocess / ssh per slot (default); "
+                        "jsrun: one jsrun invocation on an LSF cluster "
+                        "(parity: horovodrun's gloo/jsrun modes)")
     p.add_argument("--start-timeout", type=int, default=120,
                    dest="start_timeout")
     p.add_argument("--disable-cache", action="store_true",
@@ -99,6 +104,14 @@ def _resolve_hosts(args):
         return parse_hostfile(args.hostfile)
     if args.hosts:
         return parse_hosts(args.hosts)
+    # Inside an LSF allocation the job already knows its hosts
+    # (parity: run.py LSF autodetect, run/util/lsf.py).
+    from horovod_tpu.runner import lsf
+
+    if lsf.in_lsf_job():
+        hosts = lsf.lsf_hosts()
+        if hosts:
+            return hosts
     return parse_hosts(f"localhost:{args.np}")
 
 
@@ -142,6 +155,21 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if args.output_filename:
         output = open(args.output_filename, "w")
     try:
+        if args.launcher == "jsrun":
+            # One jsrun fan-out: tasks get rank/size from PMIX env
+            # (discovery.from_mpi_env) and rendezvous back here; the
+            # coordinates + secret ride the process environment.
+            import subprocess
+
+            from horovod_tpu.runner import lsf
+
+            env = dict(os.environ)
+            env.update(env_extra)
+            env.update({"HVD_RENDEZVOUS_ADDR": addr,
+                        "HVD_RENDEZVOUS_PORT": str(port)})
+            return subprocess.run(
+                lsf.jsrun_command(args.np, command), env=env,
+                stdout=output or None).returncode
         launch_workers(
             slots, command, addr, port,
             env_extra=env_extra,

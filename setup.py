@@ -1,0 +1,41 @@
+"""Build script: compiles the native coordination core into the wheel.
+
+Role parity: the reference's ``setup.py`` compiles per-framework C++
+extensions (``setup.py:47-52``).  Here there is exactly one native
+artifact — ``horovod_tpu/_lib/libhvd_core.so``, a plain shared library
+bound over ctypes (no Python headers) — built with the same compile line
+as ``csrc/Makefile`` before packaging.  ``horovod_tpu/native.py`` can
+also build it lazily from a source checkout; wheels ship it prebuilt.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = Path(__file__).resolve().parent
+CSRC = ROOT / "csrc"
+OUT = ROOT / "horovod_tpu" / "_lib" / "libhvd_core.so"
+
+SOURCES = ["wire.cc", "sockets.cc", "kernels.cc", "autotune.cc",
+           "timeline.cc", "engine.cc", "c_api.cc"]
+
+
+def build_native():
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+           "-pthread", "-shared", *SOURCES, "-o", str(OUT)]
+    print(" ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, cwd=CSRC, check=True)
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        if CSRC.is_dir():
+            build_native()
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -245,3 +245,99 @@ def test_remote_command_keeps_secret_off_argv():
     # no secret → plain command, nothing on stdin
     remote, payload = _remote_command({"HVD_RANK": "1"}, ["prog"])
     assert payload is None and "read" not in remote
+
+
+# ---------------------------------------------------------------------------
+# LSF / jsrun / MPI-env discovery
+# ---------------------------------------------------------------------------
+
+
+def test_mpi_env_discovery(monkeypatch):
+    from horovod_tpu.runner import discovery
+
+    for k in ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+              "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE",
+              "JSM_NAMESPACE_RANK", "JSM_NAMESPACE_SIZE",
+              "PMIX_RANK", "PMIX_SIZE", "PMI_RANK", "PMI_SIZE",
+              "SLURM_PROCID", "SLURM_NTASKS"):
+        monkeypatch.delenv(k, raising=False)
+    assert discovery.from_mpi_env() is None
+
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "5")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    t = discovery.from_mpi_env()
+    assert (t.rank, t.size, t.local_rank, t.local_size,
+            t.cross_rank, t.cross_size) == (5, 8, 1, 2, 2, 4)
+
+
+def test_slurm_env_discovery(monkeypatch):
+    from horovod_tpu.runner import discovery
+
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_LOCALID", "1")
+    monkeypatch.setenv("SLURM_NTASKS_PER_NODE", "2")
+    t = discovery.from_mpi_env()
+    assert (t.rank, t.size, t.local_rank, t.local_size) == (3, 4, 1, 2)
+
+
+def test_lsf_hosts_mcpu(monkeypatch):
+    from horovod_tpu.runner import lsf
+
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.delenv("LSB_DJOB_HOSTFILE", raising=False)
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "batch1 1 node1 4 node2 4")
+    assert lsf.in_lsf_job()
+    hosts = lsf.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("node1", 4), ("node2", 4)]
+
+
+def test_lsf_hosts_hostfile(monkeypatch, tmp_path):
+    from horovod_tpu.runner import lsf
+
+    hf = tmp_path / "hosts"
+    hf.write_text("batch1\nnode1\nnode1\nnode2\nnode2\n")
+    monkeypatch.setenv("LSB_JOBID", "123")
+    monkeypatch.setenv("LSB_DJOB_HOSTFILE", str(hf))
+    hosts = lsf.lsf_hosts()
+    assert [(h.hostname, h.slots) for h in hosts] == [
+        ("node1", 2), ("node2", 2)]
+
+
+def test_jsrun_command():
+    from horovod_tpu.runner import lsf
+
+    cmd = lsf.jsrun_command(8, ["python", "train.py"], cpus_per_task=4)
+    assert cmd[:5] == ["jsrun", "--np", "8", "--cpu_per_rs", "4"]
+    assert cmd[-2:] == ["python", "train.py"]
+
+
+def test_mpi_env_nonblock_layout_degrades(monkeypatch):
+    # mpirun --map-by node style: rank 1 on node1 with local_rank 0 —
+    # the block layout doesn't hold, so the topology must degrade to
+    # flat (no hierarchy) instead of ranks disagreeing about it.
+    from horovod_tpu.runner import discovery
+
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "0")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    t = discovery.from_mpi_env()
+    assert (t.rank, t.size, t.local_rank, t.local_size) == (1, 4, 0, 1)
+
+
+def test_jsm_env_discovery(monkeypatch):
+    from horovod_tpu.runner import discovery
+
+    for k in ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("JSM_NAMESPACE_RANK", "2")
+    monkeypatch.setenv("JSM_NAMESPACE_SIZE", "4")
+    monkeypatch.setenv("JSM_NAMESPACE_LOCAL_RANK", "0")
+    monkeypatch.setenv("JSM_NAMESPACE_LOCAL_SIZE", "2")
+    t = discovery.from_mpi_env()
+    assert (t.rank, t.size, t.local_rank, t.local_size,
+            t.cross_rank) == (2, 4, 0, 2, 1)
