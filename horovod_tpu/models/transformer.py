@@ -49,7 +49,11 @@ class TransformerConfig:
     compute_dtype: Any = jnp.bfloat16
     # "dense": GSPMD attention (XLA all-gathers K/V over sp);
     # "ring": blockwise ring attention via ppermute over the sp ring;
-    # "ulysses": all-to-all head exchange.  See parallel/ring_attention.py.
+    # "ulysses": all-to-all head exchange (see parallel/ring_attention.py);
+    # "flash": Pallas blockwise flash-attention kernel
+    #   (ops/pallas_attention.py) — O(S) memory, MXU-tiled; used when the
+    #   mesh has no tp/sp sharding to partition across (falls back to
+    #   dense under GSPMD sharding, where XLA cannot split a pallas_call).
     attn_impl: str = "dense"
 
     @property
@@ -186,12 +190,33 @@ def _attention(x, lp, cfg: TransformerConfig, mesh=None):
     q = _rope(q, cfg.rope_theta)
     kk = _rope(kk, cfg.rope_theta)
 
-    if cfg.attn_impl not in ("dense", "ring", "ulysses"):
+    if cfg.attn_impl not in ("dense", "ring", "ulysses", "flash"):
         raise ValueError(
-            f"attn_impl must be dense/ring/ulysses, got {cfg.attn_impl!r}")
-    use_sp = (cfg.attn_impl != "dense" and mesh is not None
+            f"attn_impl must be dense/ring/ulysses/flash, "
+            f"got {cfg.attn_impl!r}")
+    use_sp = (cfg.attn_impl in ("ring", "ulysses") and mesh is not None
               and mesh.shape.get("sp", 1) > 1)
-    if use_sp:
+    use_flash = (cfg.attn_impl == "flash"
+                 and (mesh is None
+                      or max(mesh.shape.get("tp", 1),
+                             mesh.shape.get("sp", 1)) == 1))
+    if use_flash:
+        from horovod_tpu.ops.pallas_attention import flash_attention
+
+        if mesh is not None and mesh.shape.get("dp", 1) > 1:
+            # A pallas_call has no GSPMD partitioning rule, so under a
+            # dp-sharded batch the kernel must run per-shard: wrap it in
+            # a manual-dp shard_map (tp/sp are 1 here by the guard).
+            from jax.sharding import PartitionSpec as _P
+
+            ctx = jax.shard_map(
+                lambda a, b, c: flash_attention(a, b, c, causal=True),
+                mesh=mesh, axis_names=frozenset({"dp"}),
+                in_specs=(_P("dp"), _P("dp"), _P("dp")),
+                out_specs=_P("dp"), check_vma=False)(q, kk, v)
+        else:
+            ctx = flash_attention(q, kk, v, causal=True)
+    elif use_sp:
         # Sequence-parallel attention: K/V never gather; blocks rotate the
         # sp ring (ring) or heads exchange via all-to-all (ulysses).
         from horovod_tpu.parallel import ring_attention as ra

@@ -1,0 +1,341 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The hot op of the flagship transformer, written for the hardware: the
+blockwise online-softmax algorithm keeps every [block_q, block_k] score
+tile in VMEM and never materializes the [S, S] attention matrix in HBM —
+O(S) memory instead of O(S^2), with the two matmuls per tile landing on
+the MXU.  The backward pass recomputes score tiles from the saved
+logsumexp (the standard flash recipe): one kernel accumulates dQ over key
+blocks, a second accumulates dK/dV over query blocks.
+
+This is a TPU-native extension, not a reference port (the reference has
+no attention code at all — SURVEY.md §2.8); the algorithm is the public
+FlashAttention-2 blockwise recipe re-derived for Pallas.  Composition:
+
+* ``attn_impl="flash"`` on :class:`TransformerConfig` routes the
+  non-sequence-parallel attention path here.
+* Under sequence parallelism the ring attention layer
+  (``parallel/ring_attention.py``) rotates K/V blocks over the ``sp``
+  ring with the same online-softmax update — this kernel is the
+  single-chip analog of one ring hop.
+
+Runs under ``interpret=True`` off-TPU (tests run on the CPU backend);
+on a TPU backend it compiles to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+
+def _block_needed(qi, ki, block_q, block_k, causal):
+    """False only for key blocks strictly above the causal diagonal."""
+    if not causal:
+        return True
+    return ki * block_k < (qi + 1) * block_q
+
+
+def _causal_mask(s, qi, ki, block_q, block_k):
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _pick_block(seq_len: int, want: int) -> int:
+    b = min(want, seq_len)
+    while seq_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: key blocks strictly above the diagonal contribute nothing.
+    needed = _block_needed(qi, ki, block_q, block_k, causal)
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0].astype(jnp.float32)           # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[:]                          # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)             # [bq, 1]
+        l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = corr * acc_scr[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:]
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    BH, S, D = q.shape
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(S, block_k)
+    grid = (BH, S // bq, S // bk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((bq, 1)),
+            _vmem((bq, 1)),
+            _vmem((bq, D)),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_scr, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    needed = _block_needed(qi, ki, block_q, block_k, causal)
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]                  # [bq, 1]
+        delta = delta_ref[0][:, None]              # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bk]
+        ds = p * (dp - delta)
+        acc_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, block_q, block_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = _block_needed(qi, ki, block_q, block_k, causal)
+
+    @pl.when(needed)
+    def _tile():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+    q, k, v, o, lse = res
+    do = g
+    BH, S, D = q.shape
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(S, block_k)
+    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside pallas.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                        # [BH, S]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, S // bq, S // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[_vmem((bq, D))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(BH, S // bk, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, D)), _vmem((bk, D))],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Blockwise flash attention.  ``q/k/v``: [B, S, H, D].
+
+    Returns [B, S, H, D] context.  Differentiable (custom VJP running the
+    flash backward kernels).  ``interpret`` defaults to True off-TPU so
+    the same code tests on the CPU backend.
+    """
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
+
+    o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
+               int(block_q), int(block_k), bool(interpret))
+    return jnp.moveaxis(o.reshape(B, H, S, D), 1, 2)

@@ -126,6 +126,12 @@ def pipeline_apply(params, tokens, cfg: tfm.TransformerConfig, mesh,
     B = tokens.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if cfg.attn_impl == "flash" and mesh.shape.get("dp", 1) > 1:
+        # Inside the pipeline body dp stays GSPMD-auto, and a pallas_call
+        # cannot be partitioned by GSPMD — use dense attention there.
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, attn_impl="dense")
 
     layer_fn = tfm._layer
     if remat:

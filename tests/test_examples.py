@@ -98,3 +98,14 @@ def test_pytorch_synthetic_benchmark_2proc():
          "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
          "--num-iters", "2", "--fp16-allreduce"])
     assert "Total img/sec on 2 process(es)" in out
+
+
+def test_scaling_benchmark_virtual_mesh():
+    out = run_example(
+        "scaling_benchmark.py", 1,
+        ["--model", "tiny", "--batch-per-device", "4",
+         "--devices", "1,2",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+         "--num-iters", "1"])
+    assert "scaling efficiency" in out
+    assert "weak_scaling_efficiency" in out
