@@ -204,6 +204,51 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
     return acc.astype(flat.dtype)
 
 
+class _AllreduceCandidate:
+    """One entry of the allreduce dispatch chain (parity: the reference's
+    per-category op list in ``ops/operation_manager.cc:37-104`` — ordered
+    candidates, the first whose ``enabled()`` returns True executes)."""
+
+    def enabled(self, engine, resp: Response) -> bool:
+        raise NotImplementedError
+
+    def execute(self, engine, flat: np.ndarray, op: ReduceOp) -> np.ndarray:
+        raise NotImplementedError
+
+
+class AdasumAllreduce(_AllreduceCandidate):
+    def enabled(self, engine, resp):
+        return resp.reduce_op == ReduceOp.ADASUM
+
+    def execute(self, engine, flat, op):
+        return _adasum_flat(engine, flat)
+
+
+class HierarchicalAllreduce(_AllreduceCandidate):
+    def enabled(self, engine, resp):
+        return (resp.reduce_op != ReduceOp.ADASUM
+                and getattr(engine, "hierarchical_allreduce", False)
+                and engine.hierarchical_topology_ok())
+
+    def execute(self, engine, flat, op):
+        return hierarchical_allreduce_flat(engine, flat, op)
+
+
+class RingAllreduce(_AllreduceCandidate):
+    def enabled(self, engine, resp):
+        return True
+
+    def execute(self, engine, flat, op):
+        return ring_allreduce_flat(engine, flat, op)
+
+
+# Priority order mirrors the reference's CreateOperationManager chain
+# (operations.cc:142-228): specialized ops first, flat ring as the
+# always-enabled fallback.
+ALLREDUCE_CHAIN = (AdasumAllreduce(), HierarchicalAllreduce(),
+                   RingAllreduce())
+
+
 def allreduce(engine, entries, resp: Response):
     """Fused allreduce over all entries of the response.  The op and the
     scale factors come from the negotiated response (identical on every
@@ -217,13 +262,8 @@ def allreduce(engine, entries, resp: Response):
     if prescale != 1.0:
         flat = flat * dtype.type(prescale)
 
-    if op == ReduceOp.ADASUM:
-        reduced = _adasum_flat(engine, flat)
-    elif getattr(engine, "hierarchical_allreduce", False) and \
-            engine.hierarchical_topology_ok():
-        reduced = hierarchical_allreduce_flat(engine, flat, op)
-    else:
-        reduced = ring_allreduce_flat(engine, flat, op)
+    reduced = next(c for c in ALLREDUCE_CHAIN
+                   if c.enabled(engine, resp)).execute(engine, flat, op)
 
     if op == ReduceOp.AVERAGE:
         if dtype.itemsize == 2:
@@ -298,11 +338,34 @@ def _allgather_hierarchical(engine, entries, resp: Response):
     return results
 
 
-def allgather(engine, entries, resp: Response):
-    """Ragged ring allgatherv; one entry per response."""
-    if getattr(engine, "hierarchical_allgather", False) and \
-            engine.hierarchical_topology_ok():
+class HierarchicalAllgather:
+    def enabled(self, engine, resp):
+        return (getattr(engine, "hierarchical_allgather", False)
+                and engine.hierarchical_topology_ok())
+
+    def execute(self, engine, entries, resp):
         return _allgather_hierarchical(engine, entries, resp)
+
+
+class RingAllgather:
+    def enabled(self, engine, resp):
+        return True
+
+    def execute(self, engine, entries, resp):
+        return _allgather_flat(engine, entries, resp)
+
+
+ALLGATHER_CHAIN = (HierarchicalAllgather(), RingAllgather())
+
+
+def allgather(engine, entries, resp: Response):
+    """Allgather through the candidate chain (see ALLREDUCE_CHAIN)."""
+    return next(c for c in ALLGATHER_CHAIN
+                if c.enabled(engine, resp)).execute(engine, entries, resp)
+
+
+def _allgather_flat(engine, entries, resp: Response):
+    """Ragged ring allgatherv; one entry per response."""
     size, rank = engine.size, engine.rank
     results = []
     for e in entries:

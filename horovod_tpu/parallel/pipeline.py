@@ -47,6 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.parallel.mesh import filter_spec
+from horovod_tpu.parallel.train import _step0
 
 
 def pipeline_param_specs(cfg: tfm.TransformerConfig):
@@ -226,7 +227,7 @@ def make_pipeline_train_step(
             out_shardings=_opt_shardings(optimizer, params,
                                          param_shardings))(params)
         return PipelineTrainState(params, opt_state,
-                                  jnp.zeros((), jnp.int32))
+                                  _step0(mesh))
 
     def _step(state: PipelineTrainState, tokens, targets):
         loss, grads = jax.value_and_grad(pipeline_loss_fn)(

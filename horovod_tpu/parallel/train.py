@@ -47,6 +47,15 @@ def _batch_spec(mesh, *axes) -> P:
     return filter_spec(P(*axes), mesh)
 
 
+def _step0(mesh):
+    """Mesh-replicated zero step counter.  A plain ``jnp.zeros(())`` is an
+    uncommitted single-device array — fine until a checkpoint restore
+    commits it, at which point jit rejects the mixed device sets; placing
+    it on the mesh up front keeps init and restored states identical."""
+    return jax.device_put(jnp.zeros((), jnp.int32),
+                          NamedSharding(mesh, P()))
+
+
 class TrainState(NamedTuple):
     params: Any
     opt_state: Any
@@ -89,7 +98,7 @@ def make_transformer_train_step(
             optimizer.init,
             out_shardings=_opt_shardings(optimizer, params,
                                          param_shardings))(params)
-        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+        return TrainState(params, opt_state, _step0(mesh))
 
     def _step(state: TrainState, tokens, targets):
         loss, grads = jax.value_and_grad(tfm.loss_fn)(
@@ -166,7 +175,7 @@ def make_resnet_train_step(
         stats = jax.device_put(stats, rep)
         opt_state = jax.device_put(optimizer.init(params), rep)
         return ResNetState(params, stats, opt_state,
-                           jnp.zeros((), jnp.int32))
+                           _step0(mesh))
 
     def _step(state: ResNetState, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
@@ -224,7 +233,7 @@ def make_resnet_train_step_hvd(
         stats = jax.device_put(stats, rep)
         opt_state = jax.device_put(optimizer.init(params), rep)
         return ResNetState(params, stats, opt_state,
-                           jnp.zeros((), jnp.int32))
+                           _step0(mesh))
 
     def body(state: ResNetState, images, labels):
         (loss, new_stats), grads = jax.value_and_grad(
@@ -258,7 +267,7 @@ def make_mnist_train_step(mesh, optimizer=None):
     def init_fn(rng) -> TrainState:
         params = jax.device_put(mnist_model.init(rng), rep)
         opt_state = jax.device_put(optimizer.init(params), rep)
-        return TrainState(params, opt_state, jnp.zeros((), jnp.int32))
+        return TrainState(params, opt_state, _step0(mesh))
 
     def _step(state: TrainState, images, labels):
         loss, grads = jax.value_and_grad(mnist_model.loss_fn)(

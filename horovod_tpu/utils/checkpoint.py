@@ -1,0 +1,101 @@
+"""Checkpoint / resume helpers.
+
+Role parity: the reference ships checkpointing as *idioms*, not a
+subsystem (SURVEY.md §5 — broadcast state from rank 0 at start,
+rank-0-only checkpoint writing in the examples, Spark estimators saving
+to the Store).  The TPU-native equivalent is a thin layer over orbax,
+which already understands sharded ``jax.Array`` trees (multi-host GSPMD
+checkpoints work out of the box):
+
+* :func:`save` — write a pytree checkpoint.  In the eager multi-process
+  regime state is replicated, so only rank 0 writes (the reference's
+  idiom); in the GSPMD regime every process holds distinct shards and
+  all of them must participate, so rank gating is disabled
+  automatically when the tree contains sharded arrays.
+* :func:`restore` — read it back (optionally into the sharding/dtype
+  layout of a template tree).
+* :func:`resume_or_init` — the standard training-loop entry: restore the
+  latest step if a checkpoint exists, else initialize fresh and
+  broadcast from rank 0 so every rank starts identical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+
+def _is_sharded(tree) -> bool:
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and \
+                getattr(sharding, "num_devices", 1) > 1:
+            return True
+    return False
+
+
+def save(path: str, tree: Any, *, force: bool = True) -> bool:
+    """Write ``tree`` to ``path``.  Returns True if this process wrote.
+
+    Replicated (eager-regime) state is written by rank 0 only; sharded
+    state is written collectively by every process (orbax requirement).
+    """
+    import orbax.checkpoint as ocp
+
+    from horovod_tpu import basics
+
+    sharded = _is_sharded(tree)
+    if not sharded and basics.is_initialized() and basics.rank() != 0:
+        # Replicated state, non-root rank: the reference's rank-0-only
+        # idiom.  A barrier would be wrong here (root may take a while);
+        # callers needing sync call hvd.barrier() themselves.
+        return False
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=force)
+    ckptr.wait_until_finished()
+    return True
+
+
+def restore(path: str, template: Optional[Any] = None) -> Any:
+    """Read a checkpoint; with ``template``, restore into its exact
+    sharding/structure (required for GSPMD states)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if template is not None:
+        return ckptr.restore(path, template)
+    return ckptr.restore(path)
+
+
+def exists(path: str) -> bool:
+    return os.path.isdir(path) and bool(os.listdir(path))
+
+
+def resume_or_init(path: str, init_fn: Callable[[], Any],
+                   *, broadcast: bool = True) -> Any:
+    """Restore ``path`` if present, else ``init_fn()`` (+ broadcast the
+    fresh state from rank 0 in the eager regime so ranks agree —
+    parity: the reference's broadcast-at-start idiom)."""
+    if exists(path):
+        import jax
+
+        # Prefer an abstract template (shapes/dtypes/shardings without
+        # materializing a full state that is immediately discarded);
+        # fall back to a concrete one when eval_shape can't trace
+        # init_fn or orbax needs real arrays.
+        try:
+            template = jax.eval_shape(init_fn)
+            return restore(path, template)
+        except Exception:
+            return restore(path, init_fn())
+    state = init_fn()
+    from horovod_tpu import basics
+
+    if broadcast and basics.is_initialized() and basics.size() > 1 \
+            and not _is_sharded(state):
+        from horovod_tpu.ops import eager
+
+        state = eager.broadcast_parameters(state, 0, prefix="ckpt.init")
+    return state

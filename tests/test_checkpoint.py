@@ -1,0 +1,73 @@
+"""Checkpoint/resume helpers (orbax-backed) — round-trips for replicated
+and GSPMD-sharded state."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from horovod_tpu.utils import checkpoint as ckpt  # noqa: E402
+
+
+def test_roundtrip_plain_tree(jax, tmp_path):
+    import jax.numpy as jnp
+
+    tree = {"w": jnp.arange(12.0).reshape(3, 4),
+            "b": jnp.ones((4,)),
+            "step": jnp.zeros((), jnp.int32)}
+    path = str(tmp_path / "ck")
+    assert ckpt.save(path, tree)
+    assert ckpt.exists(path)
+    back = ckpt.restore(path)
+    for k in tree:
+        np.testing.assert_allclose(np.asarray(back[k]),
+                                   np.asarray(tree[k]))
+
+
+def test_roundtrip_sharded_train_state(jax, eight_devices, tmp_path):
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.parallel import mesh as mesh_mod
+    from horovod_tpu.parallel import train as train_mod
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+        max_seq_len=32, compute_dtype=jnp.float32)
+    mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2},
+                              devices=eight_devices[:4])
+    step, init = train_mod.make_transformer_train_step(
+        cfg, mesh, optax.sgd(0.1))
+    state = init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 32)), jnp.int32)
+    state, _ = step(state, toks, jnp.roll(toks, -1, axis=1))
+
+    path = str(tmp_path / "ck")
+    assert ckpt.save(path, state)
+    template = init(jax.random.PRNGKey(1))
+    back = ckpt.restore(path, template)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+    # restored state is usable: take another step
+    back2, loss = step(back, toks, jnp.roll(toks, -1, axis=1))
+    assert np.isfinite(float(loss))
+
+
+def test_resume_or_init(jax, tmp_path):
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "ck")
+    calls = []
+
+    def init_fn():
+        calls.append(1)
+        return {"w": jnp.full((2, 2), 7.0)}
+
+    s1 = ckpt.resume_or_init(path, init_fn)
+    np.testing.assert_allclose(np.asarray(s1["w"]), 7.0)
+    ckpt.save(path, {"w": jnp.full((2, 2), 9.0)})
+    s2 = ckpt.resume_or_init(path, init_fn)
+    np.testing.assert_allclose(np.asarray(s2["w"]), 9.0)
