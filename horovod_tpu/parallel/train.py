@@ -52,8 +52,7 @@ def _step0(mesh):
     uncommitted single-device array — fine until a checkpoint restore
     commits it, at which point jit rejects the mixed device sets; placing
     it on the mesh up front keeps init and restored states identical."""
-    return jax.device_put(jnp.zeros((), jnp.int32),
-                          NamedSharding(mesh, P()))
+    return jax.device_put(jnp.zeros((), jnp.int32), _replicated(mesh))
 
 
 class TrainState(NamedTuple):

@@ -109,3 +109,37 @@ def test_scaling_benchmark_virtual_mesh():
          "--num-iters", "1"])
     assert "scaling efficiency" in out
     assert "weak_scaling_efficiency" in out
+
+
+def test_pytorch_imagenet_resnet50_2proc(tmp_path):
+    pytest.importorskip("torch")
+    ckpt = str(tmp_path / "ck-{epoch}.pt")
+    out = run_example(
+        "pytorch_imagenet_resnet50.py", 2,
+        ["--epochs", "1", "--steps-per-epoch", "4", "--batch-size", "8",
+         "--image-size", "32", "--width", "8", "--num-classes", "10",
+         "--batches-per-allreduce", "2", "--fp16-allreduce",
+         "--checkpoint-format", ckpt],
+        timeout=420)
+    assert "loss" in out
+    import os as _os
+
+    assert _os.path.exists(ckpt.format(epoch=0))
+
+
+def test_mxnet_imagenet_example_gates_cleanly():
+    import subprocess
+    import sys as _sys
+
+    try:
+        import mxnet  # noqa: F401
+
+        pytest.skip("real mxnet present; gate not applicable")
+    except ImportError:
+        pass
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(EXAMPLES,
+                                       "mxnet_imagenet_resnet50.py")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "mxnet is not installed" in proc.stderr

@@ -1,0 +1,107 @@
+"""MXNet front-end logic, executed under a mock ``mxnet`` module.
+
+The real package is not shipped in this image (EOL upstream), so the
+binding's actual code paths — rescale_grad scaling, per-index gradient
+allreduce, broadcast_parameters over a param dict — run here against a
+minimal NDArray stand-in; the ImportError gate is tested separately.
+Role parity target: ``test/test_mxnet.py``.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _FakeND:
+    """ndarray-backed stand-in for mx.nd.NDArray (asnumpy + slice set)."""
+
+    def __init__(self, arr):
+        self.arr = np.asarray(arr, np.float32)
+
+    def asnumpy(self):
+        return self.arr.copy()
+
+    def __getitem__(self, k):
+        return self.arr[k] if not isinstance(k, slice) else self
+
+    def __setitem__(self, k, v):
+        if isinstance(k, slice) and k == slice(None):
+            self.arr[...] = np.asarray(v)
+        else:
+            self.arr[k] = np.asarray(v)
+
+    def __len__(self):
+        return len(self.arr)
+
+
+class _FakeOptimizer:
+    """Duck-typed mx.optimizer.Optimizer."""
+
+    def __init__(self, lr=0.1):
+        self.lr = lr
+        self.rescale_grad = 1.0
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(("update", index))
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad \
+            * grad.asnumpy()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.updates.append(("ump", index))
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad \
+            * grad.asnumpy()
+
+
+@pytest.fixture()
+def hvd_mx(monkeypatch):
+    fake = types.ModuleType("mxnet")
+    monkeypatch.setitem(sys.modules, "mxnet", fake)
+    # Re-evaluate the module's gate under the mock.
+    import importlib
+
+    import horovod_tpu.mxnet as m
+
+    importlib.reload(m)
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    yield m
+    hvd.shutdown()
+    monkeypatch.delitem(sys.modules, "mxnet", raising=False)
+    importlib.reload(m)
+
+
+def test_distributed_optimizer_rescale_and_update(hvd_mx):
+    opt = _FakeOptimizer(lr=0.5)
+    dist = hvd_mx.DistributedOptimizer(opt)
+    # size()==1: rescale_grad divided by world size (1) stays 1.0, and
+    # update flows through to the wrapped optimizer.
+    assert dist.rescale_grad == 1.0
+    w = _FakeND([1.0, 2.0, 3.0])
+    g = _FakeND([1.0, 1.0, 1.0])
+    dist.update(0, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), [0.5, 1.5, 2.5])
+    dist.update_multi_precision(1, w, g, None)
+    np.testing.assert_allclose(w.asnumpy(), [0.0, 1.0, 2.0])
+    assert [u[0] for u in dist.updates] == ["update", "ump"]
+
+
+def test_broadcast_parameters_dict(hvd_mx):
+    params = {"w": _FakeND([1.0, 2.0]), "b": _FakeND([3.0])}
+    hvd_mx.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(params["w"].asnumpy(), [1.0, 2.0])
+    with pytest.raises(ValueError, match="invalid params"):
+        hvd_mx.broadcast_parameters([1, 2, 3])
+
+
+def test_gate_without_mxnet():
+    import horovod_tpu.mxnet as m
+
+    if m._HAVE_MXNET:
+        pytest.skip("real mxnet present")
+    with pytest.raises(ImportError, match="mxnet"):
+        m.DistributedOptimizer(object())
