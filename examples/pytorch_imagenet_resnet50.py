@@ -67,8 +67,13 @@ def main():
     torch.manual_seed(42)
 
     model = build_resnet50(args.width, args.num_classes)
+    # Sub-batch split for local gradient accumulation; n_sub is the
+    # actual number of backward passes per step (ceil handles batch
+    # sizes not divisible by batches_per_allreduce).
+    sub = max(1, args.batch_size // args.batches_per_allreduce)
+    n_sub = (args.batch_size + sub - 1) // sub
     # Horovod recipe step 1: scale LR by total batch parallelism.
-    lr_scaler = size * args.batches_per_allreduce
+    lr_scaler = size * n_sub
     optimizer = torch.optim.SGD(model.parameters(),
                                 lr=args.base_lr * lr_scaler,
                                 momentum=args.momentum,
@@ -78,7 +83,7 @@ def main():
     optimizer = hvd.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters(),
         compression=compression,
-        backward_passes_per_step=args.batches_per_allreduce)
+        backward_passes_per_step=n_sub)
 
     # Resume: rank 0 restores, then broadcast puts everyone in agreement.
     start_epoch = 0
@@ -122,11 +127,9 @@ def main():
             target = torch.from_numpy(rs.randint(
                 0, args.num_classes, (args.batch_size,)))
             optimizer.zero_grad()
-            # Split into sub-batches when accumulating; each sub-loss is
-            # divided by the sub-batch count so the accumulated gradient
-            # is the batch *mean* (the reference recipe's loss.div_).
-            sub = max(1, args.batch_size // args.batches_per_allreduce)
-            n_sub = (args.batch_size + sub - 1) // sub
+            # Each sub-loss is divided by the sub-batch count so the
+            # accumulated gradient is the batch *mean* (the reference
+            # recipe's loss.div_).
             step_loss = 0.0
             for i in range(0, args.batch_size, sub):
                 out = model(data[i:i + sub])

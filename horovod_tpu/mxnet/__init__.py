@@ -115,11 +115,37 @@ def broadcast_parameters(params, root_rank=0):
         items = sorted(params.items())
     else:
         raise ValueError("invalid params of type: %s" % type(params))
+    import mxnet as mx
+
+    deferred = getattr(
+        getattr(getattr(mx, "gluon", None), "parameter", None),
+        "DeferredInitializationError", None) or ()
     for name, p in items:
         try:
             nd = p.data()
         except AttributeError:
             nd = p
+        except deferred:
+            # Shape-deferred gluon parameter (no forward pass yet):
+            # wrap its init so the value is broadcast right after it
+            # materializes, keeping ranks in sync without forcing an
+            # early forward (same contract as the reference's
+            # post-initialization broadcast injection).
+            import types as _types
+
+            orig_init = p._init_impl
+
+            def _bcast_after_init(self, *a, _orig=orig_init, _name=name,
+                                  **kw):
+                _orig(*a, **kw)
+                nd2 = self.data()
+                out2 = eager.broadcast(
+                    nd2.asnumpy(), root_rank=root_rank,
+                    name=f"mx.bp.late.{_name}")
+                nd2[:] = out2
+
+            p._init_impl = _types.MethodType(_bcast_after_init, p)
+            continue
         out = eager.broadcast(nd.asnumpy(), root_rank=root_rank,
                               name=f"mx.bp.{name}")
         nd[:] = out

@@ -198,6 +198,19 @@ def broadcast_variables(variables, root_rank=0):
         v.assign(broadcast(v, root_rank, name=f"bv.{i}"))
 
 
+def BroadcastGlobalVariablesHook(root_rank=0, device=""):
+    """Parity surface for the reference's TF1 ``SessionRunHook``
+    (tensorflow/__init__.py:194).  TF1 sessions are not part of the TF2
+    front-end; the equivalents are :func:`broadcast_variables` after the
+    first step, or ``horovod_tpu.keras.callbacks
+    .BroadcastGlobalVariablesCallback`` for Keras training loops."""
+    raise NotImplementedError(
+        "TF1 session hooks are not supported by the TF2 front-end; call "
+        "broadcast_variables(model.variables, root_rank) after the first "
+        "training step, or use horovod_tpu.keras.callbacks."
+        "BroadcastGlobalVariablesCallback with model.fit().")
+
+
 class DistributedGradientTape:
     """Wraps a ``tf.GradientTape`` so ``gradient()`` allreduces the
     results (parity: tensorflow/__init__.py:474-531 — same wrap-an-

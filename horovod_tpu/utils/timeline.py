@@ -84,7 +84,13 @@ class Timeline:
 
     def _tid(self, tensor_name: str) -> int:
         if tensor_name not in self._tensor_tids:
-            self._tensor_tids[tensor_name] = len(self._tensor_tids) + 1
+            tid = len(self._tensor_tids) + 1
+            self._tensor_tids[tensor_name] = tid
+            # Label the lane after the tensor (chrome-tracing metadata),
+            # matching the native writer's per-tensor rows.
+            self._q.put({"ph": "M", "pid": 0, "tid": tid,
+                         "name": "thread_name",
+                         "args": {"name": tensor_name}})
         return self._tensor_tids[tensor_name]
 
     def _emit(self, ph, name, tensor_name, args=None):

@@ -217,6 +217,10 @@ def scenario_error_mismatch():
 def scenario_timeline():
     rank, size = hvd.rank(), hvd.size()
     hvd.allreduce(np.ones(4, np.float32), name="tl.tensor", op=hvd.Sum)
+    # JSON-hostile tensor name: the trace must stay parseable (the
+    # native engine escapes names; regression for the advisor finding).
+    hvd.allreduce(np.ones(2, np.float32), name='tl."quoted"\\name',
+                  op=hvd.Sum)
     hvd.barrier()
 
 

@@ -122,15 +122,10 @@ def test_pytorch_imagenet_resnet50_2proc(tmp_path):
          "--checkpoint-format", ckpt],
         timeout=420)
     assert "loss" in out
-    import os as _os
-
-    assert _os.path.exists(ckpt.format(epoch=0))
+    assert os.path.exists(ckpt.format(epoch=0))
 
 
 def test_mxnet_imagenet_example_gates_cleanly():
-    import subprocess
-    import sys as _sys
-
     try:
         import mxnet  # noqa: F401
 
@@ -138,8 +133,8 @@ def test_mxnet_imagenet_example_gates_cleanly():
     except ImportError:
         pass
     proc = subprocess.run(
-        [_sys.executable, os.path.join(EXAMPLES,
-                                       "mxnet_imagenet_resnet50.py")],
+        [sys.executable, os.path.join(EXAMPLES,
+                                      "mxnet_imagenet_resnet50.py")],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
     assert "mxnet is not installed" in proc.stderr

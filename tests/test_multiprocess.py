@@ -191,6 +191,12 @@ def test_timeline(tmp_path, engine):
     assert "NEGOTIATE_ALLREDUCE" in content
     assert '"ALLREDUCE"' in content
     assert "CYCLE_START" in content
-    # valid JSON events (strip trailing comma, close the array)
+    # valid JSON events even with a quote/backslash tensor name in the
+    # job (strip trailing comma, close the array)
     events = json.loads(content.rstrip().rstrip(",") + "]")
     assert len(events) > 0
+    # both engines label lanes; the hostile name must appear escaped in
+    # thread_name metadata without breaking the parse
+    names = {e.get("args", {}).get("name") for e in events
+             if e.get("name") == "thread_name"}
+    assert 'tl."quoted"\\name' in names, names
