@@ -67,13 +67,13 @@ def main():
     torch.manual_seed(42)
 
     model = build_resnet50(args.width, args.num_classes)
-    # Sub-batch split for local gradient accumulation; n_sub is the
-    # actual number of backward passes per step (ceil handles batch
-    # sizes not divisible by batches_per_allreduce).
-    sub = max(1, args.batch_size // args.batches_per_allreduce)
-    n_sub = (args.batch_size + sub - 1) // sub
-    # Horovod recipe step 1: scale LR by total batch parallelism.
-    lr_scaler = size * n_sub
+    # The reference recipe: each step consumes batch_size *
+    # batches_per_allreduce samples (one backward of batch_size each,
+    # one allreduce at the end), so LR scales by the total batch
+    # parallelism size * batches_per_allreduce.
+    n_acc = args.batches_per_allreduce
+    allreduce_batch = args.batch_size * n_acc
+    lr_scaler = size * n_acc
     optimizer = torch.optim.SGD(model.parameters(),
                                 lr=args.base_lr * lr_scaler,
                                 momentum=args.momentum,
@@ -83,7 +83,7 @@ def main():
     optimizer = hvd.DistributedOptimizer(
         optimizer, named_parameters=model.named_parameters(),
         compression=compression,
-        backward_passes_per_step=n_sub)
+        backward_passes_per_step=n_acc)
 
     # Resume: rank 0 restores, then broadcast puts everyone in agreement.
     start_epoch = 0
@@ -122,21 +122,22 @@ def main():
         for step in range(steps_total):
             adjust_lr(epoch, step)
             data = torch.from_numpy(rs.rand(
-                args.batch_size, 3, args.image_size,
+                allreduce_batch, 3, args.image_size,
                 args.image_size).astype(np.float32))
             target = torch.from_numpy(rs.randint(
-                0, args.num_classes, (args.batch_size,)))
+                0, args.num_classes, (allreduce_batch,)))
             optimizer.zero_grad()
-            # Each sub-loss is divided by the sub-batch count so the
-            # accumulated gradient is the batch *mean* (the reference
-            # recipe's loss.div_).
+            # One backward per batch_size sub-batch; each sub-loss is
+            # divided by the accumulation count so the accumulated
+            # gradient is the mean over the whole allreduce batch (the
+            # reference recipe's loss.div_).
             step_loss = 0.0
-            for i in range(0, args.batch_size, sub):
-                out = model(data[i:i + sub])
-                loss = F.cross_entropy(out, target[i:i + sub])
+            for i in range(0, allreduce_batch, args.batch_size):
+                out = model(data[i:i + args.batch_size])
+                loss = F.cross_entropy(out, target[i:i + args.batch_size])
                 step_loss += float(loss.detach())
-                (loss / n_sub).backward()
-            epoch_loss += step_loss / n_sub
+                (loss / n_acc).backward()
+            epoch_loss += step_loss / n_acc
             optimizer.step()
         # Horovod recipe step 3: average metrics across ranks.
         avg = hvd.allreduce(torch.tensor([epoch_loss / steps_total]),

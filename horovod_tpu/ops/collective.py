@@ -100,6 +100,8 @@ def grouped_allreduce(
     tensors,
     op: ReduceOp = ReduceOp.AVERAGE,
     axis: AxisSpec = "dp",
+    hierarchical: bool = False,
+    outer_axis: str = "dcn",
 ):
     """Fused allreduce of a pytree: the in-graph analog of the reference's
     tensor fusion (``fusion_buffer_manager.h:28-55`` + ``FuseResponses``,
@@ -109,7 +111,22 @@ def grouped_allreduce(
     dtype, reduced with a single collective each, then split back.  Fewer,
     larger collectives keep the ICI links saturated exactly like the
     reference's fusion buffer keeps NCCL busy.
+
+    ``hierarchical=True`` reduces each fused buffer with
+    :func:`hierarchical_allreduce` — the in-graph twin of
+    ``HVD_HIERARCHICAL_ALLREDUCE``.  ``axis`` must then name exactly the
+    inner (ICI) and outer (``outer_axis``, DCN) axes, so the reduction
+    set is identical to the flat path's.
     """
+    inner = None
+    if hierarchical:
+        names = _axes(axis)
+        if len(names) != 2 or outer_axis not in names:
+            raise ValueError(
+                "hierarchical grouped_allreduce needs axis to name "
+                f"exactly the inner and outer axes (got {names}, "
+                f"outer_axis={outer_axis!r})")
+        inner = names[0] if names[1] == outer_axis else names[1]
     leaves, treedef = jax.tree.flatten(tensors)
     if not leaves:
         return tensors
@@ -120,7 +137,11 @@ def grouped_allreduce(
     for dtype, idxs in by_dtype.items():
         flat = jnp.concatenate(
             [jnp.ravel(leaves[i]) for i in idxs], axis=0)
-        red = allreduce(flat, op=op, axis=axis)
+        if hierarchical:
+            red = hierarchical_allreduce(
+                flat, op=op, inner_axis=inner, outer_axis=outer_axis)
+        else:
+            red = allreduce(flat, op=op, axis=axis)
         offset = 0
         for i in idxs:
             n = leaves[i].size
@@ -190,6 +211,8 @@ def hierarchical_allreduce(
     links.  Requires dim 0 divisible by the inner axis size (the reference
     pads the fused buffer for the same reason).
     """
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("hierarchical_allreduce supports SUM/AVERAGE")
     n_in = lax.axis_size(inner_axis)
     pad = (-x.shape[0]) % n_in
     orig = x.shape[0]

@@ -35,17 +35,15 @@ from horovod_tpu.ops import collective as C
 from horovod_tpu.ops.compression import Compression
 
 
-def _allreduce_grads_ingraph(grads, op, axis, compression):
-    def _one(g):
-        c, ctx = compression.compress(g)
-        r = C.allreduce(c, op=op, axis=axis)
-        return compression.decompress(r, ctx)
-
+def _allreduce_grads_ingraph(grads, op, axis, compression,
+                             hierarchical=False, outer_axis="dcn"):
     # Fuse across leaves: compress first, group by dtype inside
     # grouped_allreduce, decompress after.
     leaves, treedef = jax.tree.flatten(grads)
     comp = [compression.compress(g) for g in leaves]
-    reduced = C.grouped_allreduce([c for c, _ in comp], op=op, axis=axis)
+    reduced = C.grouped_allreduce([c for c, _ in comp], op=op, axis=axis,
+                                  hierarchical=hierarchical,
+                                  outer_axis=outer_axis)
     out = [compression.decompress(r, ctx)
            for r, (_, ctx) in zip(reduced, comp)]
     return jax.tree.unflatten(treedef, out)
@@ -64,11 +62,19 @@ def _allreduce_grads_eager(grads, op, compression):
 
 
 def allreduce_gradients(grads, *, op: ReduceOp = ReduceOp.AVERAGE,
-                        axis=("dp",), compression=Compression.none):
-    """All-reduce a pytree of gradients (in-graph when ``axis`` given)."""
+                        axis=("dp",), compression=Compression.none,
+                        hierarchical: bool = False,
+                        outer_axis: str = "dcn"):
+    """All-reduce a pytree of gradients (in-graph when ``axis`` given).
+
+    ``hierarchical=True`` routes the fused buffers through
+    RS(ICI)→AR(DCN)→AG(ICI) — requires both the ``axis`` (inner) and a
+    ``dcn`` outer axis in the active mesh (the in-graph analog of
+    ``HVD_HIERARCHICAL_ALLREDUCE``)."""
     if axis is None:
         return _allreduce_grads_eager(grads, op, compression)
-    return _allreduce_grads_ingraph(grads, op, axis, compression)
+    return _allreduce_grads_ingraph(grads, op, axis, compression,
+                                    hierarchical, outer_axis)
 
 
 class _AccumState(NamedTuple):
@@ -84,8 +90,15 @@ def DistributedOptimizer(
     axis: Union[str, Sequence[str], None] = ("dp",),
     compression=Compression.none,
     backward_passes_per_step: int = 1,
+    hierarchical: bool = False,
+    outer_axis: str = "dcn",
 ) -> optax.GradientTransformation:
-    """Wrap an optax optimizer so updates see globally-reduced gradients."""
+    """Wrap an optax optimizer so updates see globally-reduced gradients.
+
+    ``hierarchical=True`` (in-graph regime only) reduces the fused
+    gradient buffers RS(inner/ICI)->AR(outer/DCN)->AG(inner/ICI);
+    ``axis`` must name exactly the inner and ``outer_axis`` axes.
+    """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
@@ -95,7 +108,8 @@ def DistributedOptimizer(
 
         def update_fn(grads, state, params=None, **extra):
             reduced = allreduce_gradients(
-                grads, op=op, axis=axis, compression=compression)
+                grads, op=op, axis=axis, compression=compression,
+                hierarchical=hierarchical, outer_axis=outer_axis)
             return inner.update(reduced, state, params, **extra)
 
         return optax.GradientTransformation(init_fn, update_fn)
@@ -115,7 +129,8 @@ def DistributedOptimizer(
         def reduce_branch(acc_tree):
             scaled = jax.tree.map(lambda a: a / n, acc_tree)
             return allreduce_gradients(
-                scaled, op=op, axis=axis, compression=compression)
+                scaled, op=op, axis=axis, compression=compression,
+                hierarchical=hierarchical, outer_axis=outer_axis)
 
         if axis is None:
             # Eager regime: python control flow is fine.
