@@ -72,6 +72,11 @@ def allreduce_gradients(grads, *, op: ReduceOp = ReduceOp.AVERAGE,
     ``dcn`` outer axis in the active mesh (the in-graph analog of
     ``HVD_HIERARCHICAL_ALLREDUCE``)."""
     if axis is None:
+        if hierarchical:
+            raise ValueError(
+                "hierarchical=True is an in-graph (mesh-axis) option; "
+                "the eager regime's two-level mode is the engine-side "
+                "HVD_HIERARCHICAL_ALLREDUCE knob")
         return _allreduce_grads_eager(grads, op, compression)
     return _allreduce_grads_ingraph(grads, op, axis, compression,
                                     hierarchical, outer_axis)

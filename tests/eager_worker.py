@@ -199,6 +199,22 @@ def scenario_barrier():
         hvd.barrier()
 
 
+def scenario_resume_or_init():
+    # Fresh init path of the checkpoint helper: per-rank-divergent init
+    # must come out rank-0-agreed on every rank (broadcast-at-start).
+    import tempfile
+
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    rank = hvd.rank()
+    state = ckpt.resume_or_init(
+        tempfile.mkdtemp() + "/missing",
+        lambda: {"w": np.full((3,), float(rank), np.float32),
+                 "b": np.array(rank, np.float32)})
+    np.testing.assert_allclose(state["w"], np.zeros(3))
+    np.testing.assert_allclose(np.asarray(state["b"]).reshape(()), 0.0)
+
+
 def scenario_error_mismatch():
     rank, size = hvd.rank(), hvd.size()
     # mismatched shapes must produce an error on every rank

@@ -158,6 +158,11 @@ def test_barrier(engine):
     run_workers("barrier", 2, engine=engine)
 
 
+def test_checkpoint_resume_or_init_broadcasts():
+    # The fresh-init branch uses only the eager engine (no orbax import).
+    run_workers("resume_or_init", 2)
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_error_mismatch(engine):
     run_workers("error_mismatch", 2, engine=engine)
