@@ -1,0 +1,152 @@
+"""Distributed skip-gram word2vec with sparse embedding gradients, JAX
+edition.
+
+Parity: ``examples/tensorflow_word2vec.py`` in the reference — skip-gram
+with NCE loss whose embedding gradients are *sparse* (only the rows
+touched by the batch), combined across ranks through the IndexedSlices
+path (allgather of values + indices, never densified;
+reference tensorflow/__init__.py:74-89, SURVEY.md §2.8.4).  Here that is
+``hvd.sparse_allreduce``; the dense NCE-bias gradient rides the ordinary
+allreduce so both data planes appear in one script.  Run:
+
+    hvdrun -np 4 python examples/jax_word2vec.py
+
+Uses a synthetic Zipf-distributed corpus so the example is hermetic (the
+reference downloads text8; this environment has no egress).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def make_batches(rs, corpus, batch_size, window, n_neg, vocab):
+    """Yield (centers, contexts, negatives) skip-gram batches forever."""
+    n = len(corpus)
+    while True:
+        centers = rs.randint(window, n - window, batch_size)
+        offs = rs.randint(1, window + 1, batch_size)
+        signs = rs.choice([-1, 1], batch_size)
+        contexts = corpus[centers + offs * signs]
+        negatives = rs.randint(0, vocab, (batch_size, n_neg))
+        yield corpus[centers], contexts, negatives
+
+
+def nce_loss(emb_rows, w_rows, b_rows):
+    """Noise-contrastive loss on gathered rows only.
+
+    ``emb_rows``: [B, D] center embeddings; ``w_rows``: [B, 1+K, D] output
+    vectors for the true context (slot 0) and K negatives; ``b_rows``:
+    [B, 1+K] biases.  Gradients w.r.t. these gathered arrays stay sparse
+    in the vocabulary dimension — the reference's IndexedSlices regime.
+    """
+    logits = jnp.einsum("bd,bkd->bk", emb_rows, w_rows) + b_rows
+    labels = jnp.zeros_like(logits).at[:, 0].set(1.0)
+    # Numerically-stable sigmoid cross-entropy.
+    loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+        jnp.exp(-jnp.abs(logits)))
+    return loss.sum(axis=1).mean()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab-size", type=int, default=2000)
+    p.add_argument("--embedding-dim", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--window", type=int, default=2)
+    p.add_argument("--num-neg", type=int, default=8)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--corpus-len", type=int, default=100_000)
+    p.add_argument("--lr", type=float, default=0.5)
+    args = p.parse_args()
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    # Synthetic corpus: Zipf-ish token stream with local correlation so
+    # skip-gram has structure to learn; each rank reads its own shard.
+    rs = np.random.RandomState(1234 + rank)
+    zipf = 1.0 / np.arange(1, args.vocab_size + 1)
+    probs = zipf / zipf.sum()
+    corpus = rs.choice(args.vocab_size, args.corpus_len, p=probs)
+    # Correlate neighbors: every even position copies a near-by token id.
+    corpus[1::2] = np.minimum(corpus[:-1:2] + rs.randint(0, 3, len(corpus[1::2])),
+                              args.vocab_size - 1)
+
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    emb = jax.random.uniform(k1, (args.vocab_size, args.embedding_dim),
+                             jnp.float32, -1.0, 1.0)
+    nce_w = jax.random.normal(
+        k2, (args.vocab_size, args.embedding_dim),
+        jnp.float32) / np.sqrt(args.embedding_dim)
+    nce_b = jnp.zeros((args.vocab_size,), jnp.float32)
+
+    # Horovod idiom #1: identical initial state everywhere.
+    emb, nce_w, nce_b = hvd.broadcast_parameters((emb, nce_w, nce_b),
+                                                 root_rank=0)
+
+    @jax.jit
+    def grad_step(emb, nce_w, nce_b, centers, cands):
+        emb_rows = emb[centers]                       # [B, D]
+        w_rows = nce_w[cands]                         # [B, 1+K, D]
+        b_rows = nce_b[cands]                         # [B, 1+K]
+        loss, grads = jax.value_and_grad(nce_loss, argnums=(0, 1, 2))(
+            emb_rows, w_rows, b_rows)
+        return loss, grads
+
+    @jax.jit
+    def apply_sparse(param, values, indices, lr):
+        return param.at[indices].add(-lr * values)
+
+    batches = make_batches(rs, corpus, args.batch_size, args.window,
+                           args.num_neg, args.vocab_size)
+    t0 = time.time()
+    for step in range(args.steps):
+        centers, contexts, negatives = next(batches)
+        cands = np.concatenate([contexts[:, None], negatives], axis=1)
+        loss, (g_emb, g_w, g_b) = grad_step(emb, nce_w, nce_b,
+                                            jnp.asarray(centers),
+                                            jnp.asarray(cands))
+
+        # Horovod idiom #2, sparse flavor: combine only the touched rows.
+        v, i = hvd.sparse_allreduce(np.asarray(g_emb), centers,
+                                    op=hvd.Average, name="grad.emb")
+        emb = apply_sparse(emb, jnp.asarray(v), jnp.asarray(i), args.lr)
+        flat_cands = cands.reshape(-1)
+        v, i = hvd.sparse_allreduce(
+            np.asarray(g_w).reshape(-1, args.embedding_dim), flat_cands,
+            op=hvd.Average, name="grad.nce_w")
+        nce_w = apply_sparse(nce_w, jnp.asarray(v), jnp.asarray(i), args.lr)
+        # Bias gradient is tiny; send it dense through the normal path.
+        dense_gb = np.zeros((args.vocab_size,), np.float32)
+        np.add.at(dense_gb, flat_cands, np.asarray(g_b).reshape(-1))
+        dense_gb = hvd.allreduce(dense_gb, op=hvd.Average, name="grad.nce_b")
+        nce_b = nce_b - args.lr * jnp.asarray(dense_gb)
+
+        if step % 100 == 0:
+            avg = hvd.allreduce(np.asarray(loss), op=hvd.Average,
+                                name="metric.loss")
+            if rank == 0:
+                print(f"step {step}: nce loss "
+                      f"{float(np.ravel(avg)[0]):.4f}")
+    if rank == 0:
+        rate = args.steps * args.batch_size * size / (time.time() - t0)
+        print(f"done: {rate:.0f} words/sec across {size} process(es)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

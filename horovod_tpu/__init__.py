@@ -72,6 +72,7 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     grouped_allreduce,
     join,
     poll,
+    sparse_allreduce,
     synchronize,
 )
 from horovod_tpu.parallel.optimizer import (  # noqa: F401

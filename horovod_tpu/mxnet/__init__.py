@@ -40,6 +40,49 @@ def _require_mxnet(what: str):
             "horovod_tpu.tensorflow for supported front-ends.")
 
 
+def _from_nd(tensor):
+    """NDArray → numpy; anything else passes through untouched."""
+    if _HAVE_MXNET and hasattr(tensor, "asnumpy"):
+        return tensor.asnumpy()
+    return tensor
+
+
+def _to_nd(out, like):
+    """Return ``out`` in the caller's type (NDArray in, NDArray out)."""
+    if _HAVE_MXNET and hasattr(like, "asnumpy"):
+        import mxnet as mx
+
+        nd = getattr(mx, "nd", None)
+        if nd is not None and hasattr(nd, "array"):
+            return nd.array(out, dtype=out.dtype)
+    return out
+
+
+def allreduce(tensor, average=None, name=None, op=None):
+    """Parity: mxnet/mpi_ops.py ``allreduce`` — accepts an NDArray (or
+    anything the eager engine takes: numpy, scalars) and returns the
+    combined tensor in the caller's type."""
+    from horovod_tpu.ops import eager
+
+    return _to_nd(eager.allreduce(_from_nd(tensor), average=average,
+                                  name=name, op=op), tensor)
+
+
+def allgather(tensor, name=None):
+    """Parity: mxnet/mpi_ops.py ``allgather`` (ragged first dims)."""
+    from horovod_tpu.ops import eager
+
+    return _to_nd(eager.allgather(_from_nd(tensor), name=name), tensor)
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    """Parity: mxnet/mpi_ops.py ``broadcast``."""
+    from horovod_tpu.ops import eager
+
+    return _to_nd(eager.broadcast(_from_nd(tensor), root_rank=root_rank,
+                                  name=name), tensor)
+
+
 def DistributedOptimizer(optimizer, op=None):
     """Parity: mxnet/__init__.py:40-69 — wraps an mxnet optimizer,
     allreducing gradients with rescale_grad divided by world size."""

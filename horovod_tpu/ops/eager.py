@@ -194,6 +194,33 @@ def allgather(tensor, name: Optional[str] = None):
     return synchronize(allgather_async(tensor, name))
 
 
+def sparse_allreduce(values, indices, average: Optional[bool] = None,
+                     name: Optional[str] = None,
+                     op: Optional[ReduceOp] = None):
+    """Sparse (IndexedSlices-style) allreduce of embedding-row gradients.
+
+    Parity: the reference never densifies sparse gradients — it
+    allgathers each slice's values and indices and lets the optimizer
+    apply them, duplicates accumulating (tensorflow/__init__.py:74-89,
+    SURVEY.md §2.8.4).  Returns ``(values, indices)`` of the combined
+    slices, where ``values`` has been pre-divided by ``size()`` when the
+    resolved op is Average.  Apply with a scatter-add, e.g.
+    ``param = param.at[indices].add(-lr * values)`` in JAX.
+    """
+    rop = _resolve_op(op, average)
+    if rop not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(
+            f"sparse_allreduce supports Average/Sum, got {rop}")
+    base = _auto_name("sparse_allreduce", name)
+    hv = allgather_async(values, name=f"{base}.values")
+    hi = allgather_async(indices, name=f"{base}.indices")
+    out_values = synchronize(hv)
+    out_indices = synchronize(hi)
+    if rop == ReduceOp.AVERAGE:
+        out_values = out_values / basics.size()
+    return out_values, out_indices
+
+
 def broadcast_async(tensor, root_rank: int = 0,
                     name: Optional[str] = None) -> int:
     arr, restore = _to_numpy(tensor)

@@ -50,6 +50,7 @@ _SLOW_NODEIDS = (
     "test_examples.py::test_jax_synthetic_benchmark_single",
     "test_examples.py::test_jax_synthetic_benchmark_2proc_fp16",
     "test_examples.py::test_tensorflow2_mnist_2proc",
+    "test_examples.py::test_keras_mnist_2proc",
     "test_examples.py::test_tensorflow2_synthetic_benchmark_2proc_fp16",
     "test_examples.py::test_pytorch_synthetic_benchmark_2proc",
     "test_tf_keras_binding.py::test_tf_graph_mode",

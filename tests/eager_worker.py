@@ -107,6 +107,28 @@ def scenario_allgather():
     np.testing.assert_array_equal(out, expect)
 
 
+def scenario_sparse_allreduce():
+    rank, size = hvd.rank(), hvd.size()
+    # Each rank touches an overlapping, ragged set of embedding rows
+    # (rank r contributes r+1 slices); duplicates must accumulate.
+    indices = np.arange(rank + 1, dtype=np.int64)
+    values = np.full((rank + 1, 4), float(rank + 1), np.float32)
+    out_v, out_i = hvd.sparse_allreduce(values, indices, op=hvd.Average,
+                                        name="sp.emb")
+    dense = np.zeros((size, 4), np.float32)
+    np.add.at(dense, out_i, out_v)
+    expect = np.zeros((size, 4), np.float32)
+    for r in range(size):
+        expect[: r + 1] += (r + 1.0) / size
+    np.testing.assert_allclose(dense, expect, rtol=1e-6)
+    # Sum op leaves values unscaled.
+    out_v, out_i = hvd.sparse_allreduce(values, indices, op=hvd.Sum,
+                                        name="sp.emb_sum")
+    dense_sum = np.zeros((size, 4), np.float32)
+    np.add.at(dense_sum, out_i, out_v)
+    np.testing.assert_allclose(dense_sum, expect * size, rtol=1e-6)
+
+
 def scenario_broadcast():
     rank, size = hvd.rank(), hvd.size()
     for root in range(size):

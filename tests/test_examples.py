@@ -42,6 +42,14 @@ def test_jax_mnist_2proc():
     assert "images/sec" in out
 
 
+def test_jax_word2vec_2proc():
+    out = run_example("jax_word2vec.py", 2,
+                      ["--steps", "60", "--corpus-len", "5000",
+                       "--batch-size", "32", "--vocab-size", "500"])
+    assert "nce loss" in out
+    assert "words/sec" in out
+
+
 def test_jax_synthetic_benchmark_single():
     out = run_example(
         "jax_synthetic_benchmark.py", 1,
@@ -125,7 +133,17 @@ def test_pytorch_imagenet_resnet50_2proc(tmp_path):
     assert os.path.exists(ckpt.format(epoch=0))
 
 
-def test_mxnet_imagenet_example_gates_cleanly():
+def test_keras_mnist_2proc():
+    out = run_example("keras_mnist.py", 2,
+                      ["--epochs", "2", "--samples", "256",
+                       "--batch-size", "64"],
+                      timeout=420)
+    assert "accuracy (avg over 2 ranks)" in out
+
+
+@pytest.mark.parametrize(
+    "script", ["mxnet_mnist.py", "mxnet_imagenet_resnet50.py"])
+def test_mxnet_example_gates_cleanly(script):
     try:
         import mxnet  # noqa: F401
 
@@ -133,8 +151,7 @@ def test_mxnet_imagenet_example_gates_cleanly():
     except ImportError:
         pass
     proc = subprocess.run(
-        [sys.executable, os.path.join(EXAMPLES,
-                                      "mxnet_imagenet_resnet50.py")],
+        [sys.executable, os.path.join(EXAMPLES, script)],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
     assert "mxnet is not installed" in proc.stderr

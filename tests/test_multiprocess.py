@@ -109,6 +109,11 @@ def test_broadcast(engine):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_sparse_allreduce(engine):
+    run_workers("sparse_allreduce", 3, engine=engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_alltoall(engine):
     run_workers("alltoall", 3, engine=engine)
 

@@ -98,6 +98,23 @@ def test_broadcast_parameters_dict(hvd_mx):
         hvd_mx.broadcast_parameters([1, 2, 3])
 
 
+def test_mpi_ops_surface(hvd_mx):
+    # size()==1: allreduce/broadcast are identity, allgather returns the
+    # input; NDArray-typed inputs come back as arrays (the mock module
+    # has no nd.array constructor, so numpy is the documented fallback).
+    x = _FakeND([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        np.asarray(hvd_mx.allreduce(x, name="mx.ar")), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        np.asarray(hvd_mx.allgather(x, name="mx.ag")), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(
+        np.asarray(hvd_mx.broadcast(x, root_rank=0, name="mx.bc")),
+        [1.0, 2.0, 3.0])
+    # Plain numpy works without mxnet types at all.
+    np.testing.assert_allclose(
+        hvd_mx.allreduce(np.float32(4.0), name="mx.scalar"), [4.0])
+
+
 def test_gate_without_mxnet():
     import horovod_tpu.mxnet as m
 
