@@ -50,9 +50,21 @@ def bootstrap_mesh(
     # addresses on a still-running server.
     scope = os.environ.get("HVD_RDV_SCOPE", "")
     prefix = f"hvd/{scope}/" if scope else "hvd/"
-    # Learn the address peers can reach us at from the route the rendezvous
-    # connection takes (works multi-host without NIC configuration).
-    my_host = kv.local_address() or "127.0.0.1"
+    # Advertise the probed/named NIC when the launcher picked one
+    # (ring-probe result or --network-interface, HVD_NIC); otherwise
+    # learn the address peers can reach us at from the route the
+    # rendezvous connection takes (works multi-host without NIC
+    # configuration).
+    my_host = None
+    nic = os.environ.get("HVD_NIC")
+    if nic:
+        from horovod_tpu.runner.run import interface_address_any
+
+        try:
+            my_host = interface_address_any(nic)
+        except ValueError:
+            my_host = None  # NIC list from another host; fall back
+    my_host = my_host or kv.local_address() or "127.0.0.1"
     kv.put(f"{prefix}addr/{rank}", f"{my_host}:{port}")
     peers = {}
     for i in range(size):

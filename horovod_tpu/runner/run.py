@@ -143,6 +143,10 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     env_extra[secret_mod.ENV_VAR] = job_secret
 
     nic_addr = interface_address_any(args.nics) if args.nics else None
+    if args.nics:
+        # Workers advertise on the named NIC too (bootstrap_mesh reads
+        # HVD_NIC), not just the launcher's rendezvous bind.
+        env_extra["HVD_NIC"] = args.nics
     server = RendezvousServer(host=nic_addr or "0.0.0.0",
                               secret=job_secret)
     port = server.start()
@@ -151,6 +155,28 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     multi_host = any(not _is_local(s.hostname) for s in slots)
     addr = nic_addr or (_routable_address() if multi_host
                         else "127.0.0.1")
+    if multi_host and not nic_addr:
+        # No NIC named: ring-probe the hosts for mutually routable
+        # interfaces (parity: run/driver/driver_service.py:128-198)
+        # instead of trusting the default-route guess on every host.
+        try:
+            common = probe_common_nics(
+                [s.hostname for s in slots], addr, port, job_secret,
+                ssh_port=args.ssh_port,
+                ssh_identity_file=args.ssh_identity_file)
+            env_extra["HVD_NIC"] = ",".join(common)
+            # Only re-point the rendezvous at the common NIC when the
+            # launcher itself was in the probe ring (has a local slot);
+            # on a pure-remote job, a same-named launcher NIC was never
+            # validated, while the current addr demonstrably works (the
+            # agents just used it).
+            if any(_is_local(s.hostname) for s in slots):
+                probe_addr = interface_address(common[0])
+                if probe_addr:
+                    addr = probe_addr
+        except Exception as e:  # discovery must never kill the launch
+            print(f"hvdrun: NIC ring probe failed ({e}); "
+                  "falling back to the default route", file=sys.stderr)
     output = None
     if args.output_filename:
         output = open(args.output_filename, "w")
@@ -189,6 +215,54 @@ def _is_local(hostname: str) -> bool:
     return is_local(hostname)
 
 
+def probe_common_nics(hostnames: List[str], rdv_addr: str, rdv_port: int,
+                      job_secret: str, *,
+                      ssh_port: Optional[int] = None,
+                      ssh_identity_file: Optional[str] = None,
+                      wait_timeout: float = 60.0) -> List[str]:
+    """Run one nic_probe agent per unique host through the normal spawn
+    path and intersect their routable-interface reports; returns common
+    NIC names, non-loopback first.  Raises if no interface is reachable
+    from every host."""
+    import threading
+
+    from horovod_tpu.runner import nic_probe
+    from horovod_tpu.runner.hosts import SlotInfo
+
+    uniq = list(dict.fromkeys(hostnames))
+    n = len(uniq)
+    agent_slots = [
+        SlotInfo(hostname=h, rank=i, size=n, local_rank=0, local_size=1,
+                 cross_rank=i, cross_size=n)
+        for i, h in enumerate(uniq)]
+    kv = KVClient("127.0.0.1", rdv_port, secret=job_secret)
+    result: dict = {}
+
+    def _intersect():
+        try:
+            result["nics"] = nic_probe.common_interfaces(
+                kv, n, wait_timeout=wait_timeout)
+        except Exception as e:
+            result["error"] = e
+
+    t = threading.Thread(target=_intersect, daemon=True)
+    t.start()
+    with open(os.devnull, "w") as devnull:
+        launch_workers(
+            agent_slots,
+            [sys.executable, "-m", "horovod_tpu.runner.nic_probe"],
+            rdv_addr, rdv_port,
+            env_extra={secret_mod.ENV_VAR: job_secret},
+            ssh_port=ssh_port, ssh_identity_file=ssh_identity_file,
+            prefix_output=False, output=devnull)
+    t.join(timeout=wait_timeout)
+    if "error" in result:
+        raise result["error"]
+    if "nics" not in result:
+        raise TimeoutError("NIC probe intersection timed out")
+    return result["nics"]
+
+
 def _routable_address() -> str:
     import socket
 
@@ -203,9 +277,10 @@ def _routable_address() -> str:
 
 
 def interface_address(ifname: str) -> Optional[str]:
-    """IPv4 address of a named interface (SIOCGIFADDR ioctl — stdlib only;
-    the reference resolves NICs with psutil + a task-service ring probe,
-    run/driver/driver_service.py:128-198)."""
+    """IPv4 address of a named interface (SIOCGIFADDR ioctl — stdlib
+    only; the automatic equivalent of the reference's psutil NIC listing.
+    The ring-probe counterpart of run/driver/driver_service.py:128-198
+    is ``probe_common_nics`` / ``runner.nic_probe``)."""
     import fcntl
     import socket
     import struct

@@ -229,6 +229,79 @@ def test_interface_address():
         interface_address_any("definitely-not-a-nic")
 
 
+def test_nic_probe_enumerate():
+    from horovod_tpu.runner.nic_probe import enumerate_interfaces
+
+    ifaces = enumerate_interfaces()
+    assert ifaces.get("lo") == "127.0.0.1"
+    for name, addr in ifaces.items():
+        assert addr.count(".") == 3, (name, addr)
+
+
+def test_nic_probe_ring_end_to_end():
+    """Two agents (one in-process, one as the real ``python -m`` agent
+    subprocess) against a live HMAC-signed rendezvous; the launcher-side
+    intersection must find at least loopback routable on both."""
+    import subprocess
+    import sys
+    import threading
+
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.http_client import KVClient
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.runner.nic_probe import common_interfaces, run_agent
+
+    s = secret_mod.make_secret()
+    server = RendezvousServer("127.0.0.1", secret=s)
+    port = server.start()
+    try:
+        kv = KVClient("127.0.0.1", port, secret=s)
+        agent0 = threading.Thread(
+            target=run_agent, args=(0, 2, kv),
+            kwargs={"probe_timeout": 2.0, "wait_timeout": 30.0},
+            daemon=True)
+        agent0.start()
+        env = dict(os.environ, HVD_RANK="1", HVD_SIZE="2",
+                   HVD_RENDEZVOUS_ADDR="127.0.0.1",
+                   HVD_RENDEZVOUS_PORT=str(port),
+                   **{secret_mod.ENV_VAR: s})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.runner.nic_probe"],
+            env=env, stdout=subprocess.PIPE, text=True)
+        common = common_interfaces(kv, 2, wait_timeout=30.0)
+        agent0.join(timeout=10)
+        out, _ = proc.communicate(timeout=10)
+        assert proc.returncode == 0, out
+        assert "routable" in out
+        # Same machine: loopback must be mutually routable, and any
+        # non-loopback interface must sort ahead of it.
+        assert "lo" in common
+        assert common[-1] == "lo" or len(common) == 1
+    finally:
+        server.stop()
+
+
+def test_nic_probe_launcher_helper():
+    """probe_common_nics drives the full spawn path (local agents) and
+    returns the intersected NIC list."""
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.runner.run import probe_common_nics
+
+    s = secret_mod.make_secret()
+    server = RendezvousServer("127.0.0.1", secret=s)
+    port = server.start()
+    try:
+        # Two distinct-but-local hostnames -> a real 2-agent ring
+        # without needing ssh.
+        common = probe_common_nics(
+            ["localhost", "127.0.0.1"], "127.0.0.1", port, s,
+            wait_timeout=60.0)
+        assert "lo" in common
+    finally:
+        server.stop()
+
+
 def test_remote_command_keeps_secret_off_argv():
     from horovod_tpu.runner.launch import _remote_command
 
