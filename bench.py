@@ -1,6 +1,8 @@
 """Benchmark: ResNet-50 synthetic images/sec — the reference's headline
 metric (``examples/tensorflow2_synthetic_benchmark.py``: ResNet-50, batch
-32, images/sec per device, mean over timed iterations after warmup).
+32, images/sec per device; we report the median over timed iterations
+after warmup — the reference uses the mean, but the tunnel transport in
+this environment has hiccups the median is robust to).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
@@ -16,6 +18,9 @@ Beyond the reference's images/sec, the line carries:
   examples/tensorflow2_synthetic_benchmark.py:119-130).
 * ``fp16_allreduce_images_per_sec`` — the ``--fp16-allreduce`` twin
   (Compression.fp16 on the gradient collectives).
+* ``transformer_tokens_per_sec`` / ``transformer_mfu`` — the flagship
+  decoder LM (Pallas flash attention on the chip), the model family the
+  reference doesn't have.
 
 ``vs_baseline`` compares against the reference's only published per-device
 throughput: 1656.82 images/sec on 16 Pascal GPUs (docs/benchmarks.rst:28-42)
@@ -60,7 +65,6 @@ def _peak_flops(device_kind: str):
 
 def _timed_images_per_sec(step, state, images, labels, batch, iters,
                           batches_per_iter):
-    import jax
     import numpy as np
 
     img_secs = []
@@ -68,10 +72,16 @@ def _timed_images_per_sec(step, state, images, labels, batch, iters,
         t0 = time.perf_counter()
         for _ in range(batches_per_iter):
             state, loss = step(state, images, labels)
-        jax.block_until_ready(loss)
+        # Host readback, not block_until_ready: a device→host transfer
+        # of the chain's final loss cannot complete before the chain
+        # has, which block_until_ready on the experimental tunnel
+        # platform occasionally (wrongly) does — it produced a
+        # physically impossible reading once.
+        float(np.asarray(loss).ravel()[0])
         dt = time.perf_counter() - t0
         img_secs.append(batch * batches_per_iter / dt)
-    return float(np.mean(img_secs)), state
+    # Median: robust to one-off relay hiccups in either direction.
+    return float(np.median(img_secs)), state
 
 
 def _step_flops(step, state, images, labels):
@@ -159,6 +169,66 @@ def main() -> None:
             extras["mfu"] = round(achieved / peak, 4)
         extras["step_flops"] = round(flops, 1)
 
+    # --- dispatch-amortized variants: the tunnel in this environment
+    # adds multi-ms per-step dispatch latency, so the 10-batch reference
+    # protocol under-reads the chip.  Report (a) a 50-step chain
+    # (dispatch amortized) and (b) a jit-fused lax.scan of 10 steps (one
+    # dispatch per iteration — the XLA-native training-loop shape).
+    if on_tpu:
+        try:
+            v50, state = _timed_images_per_sec(
+                step, state, images, labels, batch, 5, 50)
+            extras["steady_images_per_sec"] = round(v50, 2)
+
+            import jax.lax as lax
+
+            def scan10(state, images, labels):
+                def body(s, _):
+                    s, l = step(s, images, labels)
+                    return s, l
+                state, losses = lax.scan(body, state, None, length=10)
+                return state, losses[-1]
+
+            scan_step = jax.jit(scan10, donate_argnums=(0,))
+            for _ in range(2):
+                state, sloss = scan_step(state, images, labels)
+            float(np.asarray(sloss).ravel()[0])
+            vscan, state = _timed_images_per_sec(
+                scan_step, state, images, labels, batch * 10, 5, 3)
+            extras["scan_fused_images_per_sec"] = round(vscan, 2)
+            if flops:
+                best = max(v50, vscan)
+                peak = _peak_flops(devices[0].device_kind)
+                if peak:
+                    extras["steady_mfu"] = round(
+                        flops * best / batch / peak, 4)
+        except Exception as e:
+            extras["steady_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- large-batch variant: batch 128 (the reference pins batch 32 for
+    # comparability; the chip's MXU utilization peaks at larger batches,
+    # so report the bigger number alongside, not instead).
+    if on_tpu:
+        try:
+            big = 128
+            big_images = jnp.asarray(rs.rand(big, size, size, 3),
+                                     jnp.float32)
+            big_labels = jnp.asarray(rs.randint(0, cfg.num_classes,
+                                                (big,)))
+            mesh1 = mesh_mod.make_mesh({"dp": 1}, devices=devices[:1])
+            bstep, binit = train_mod.make_resnet_train_step(
+                cfg, mesh1, optax.sgd(0.01, momentum=0.9))
+            bstate = binit(jax.random.PRNGKey(0))
+            for _ in range(warmup_iters):
+                bstate, bloss = bstep(bstate, big_images, big_labels)
+            jax.block_until_ready(bloss)
+            bval, _ = _timed_images_per_sec(
+                bstep, bstate, big_images, big_labels, big, iters,
+                batches_per_iter)
+            extras["batch128_images_per_sec"] = round(bval, 2)
+        except Exception as e:
+            extras["batch128_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # --- collective path: DistributedOptimizer → grouped_allreduce -------
     # On the single real TPU chip the dp axis is 1 (the collective lowers
     # to the identity but rides the same fused grouped_allreduce program);
@@ -192,6 +262,50 @@ def main() -> None:
             bench_hvd_step(Compression.fp16), 2)
     except Exception as e:  # never lose the headline number to a variant
         extras["variant_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- flagship transformer LM: tokens/sec + MFU ----------------------
+    # The framework's flagship model family (beyond the reference, which
+    # is CNN-only): decoder LM with the Pallas flash-attention kernel on
+    # the real chip.  bf16, MXU-sized matmuls — this is the number that
+    # reflects how the design maps to the hardware.
+    try:
+        from horovod_tpu.models import transformer as tfm
+        from horovod_tpu.parallel import train as tr
+
+        if on_tpu:
+            tcfg = tfm.TransformerConfig(
+                vocab_size=32768, d_model=1024, n_layers=8, n_heads=16,
+                d_ff=4096, max_seq_len=1024, attn_impl="flash")
+            tbatch, tseq, titers = 8, 1024, 5
+        else:
+            tcfg = tfm.TransformerConfig(
+                vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                d_ff=128, max_seq_len=64, compute_dtype=jnp.float32)
+            # batch must divide over the dp axis of the virtual mesh
+            tbatch, tseq, titers = 2 * len(dp_devs), 64, 2
+        tmesh = mesh_mod.make_mesh({"dp": len(dp_devs)},
+                                   devices=dp_devs)
+        tstep, tinit = tr.make_transformer_train_step(tcfg, tmesh)
+        tstate = tinit(jax.random.PRNGKey(0))
+        toks = jnp.asarray(rs.randint(0, tcfg.vocab_size, (tbatch, tseq)),
+                           jnp.int32)
+        tgts = jnp.roll(toks, -1, axis=1)
+        tflops = _step_flops(tstep, tstate, toks, tgts)
+        for _ in range(warmup_iters):
+            tstate, tloss = tstep(tstate, toks, tgts)
+        float(np.asarray(tloss).ravel()[0])
+        tok_rate, tstate = _timed_images_per_sec(
+            tstep, tstate, toks, tgts, tbatch * tseq, titers,
+            batches_per_iter)
+        extras["transformer_tokens_per_sec"] = round(tok_rate, 1)
+        if tflops:
+            t_achieved = tflops * tok_rate / (tbatch * tseq)
+            extras["transformer_flops_per_sec"] = round(t_achieved, 1)
+            peak = _peak_flops(devices[0].device_kind) if on_tpu else None
+            if peak:
+                extras["transformer_mfu"] = round(t_achieved / peak, 4)
+    except Exception as e:
+        extras["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
 
     baseline = 1656.82 / 16.0  # reference's per-device number
     line = {

@@ -434,6 +434,13 @@ int Engine::Barrier(std::string* err) {
   int64_t h = Enqueue(std::move(e), err);
   if (h < 0) return -1;
   StatusType st = handles_.Wait(h);
+  if (st != StatusType::OK && err) {
+    HandleState* hs = handles_.Get(h);
+    *err = (hs && !hs->status.reason.empty())
+               ? hs->status.reason
+               : "barrier failed (status " +
+                     std::to_string(static_cast<int>(st)) + ")";
+  }
   handles_.Release(h);
   return st == StatusType::OK ? 0 : -1;
 }

@@ -108,7 +108,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_scr[:]
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0] = m_scr[:] + jnp.log(l)         # [bq, 1]
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -128,11 +128,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # lse rides as [BH, S, 1]: a 2-D (1, bq) block over [BH, S]
+            # is not Mosaic-tileable (second-minor must be 8-divisible
+            # or the full dim); a trailing singleton lane dim is.
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((bq, 1)),
@@ -173,8 +176,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]                  # [bq, 1]
-        delta = delta_ref[0][:, None]              # [bq, 1]
+        lse = lse_ref[0]                           # [bq, 1]
+        delta = delta_ref[0]                       # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -214,8 +217,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                           # [bq, 1]
+        delta = delta_ref[0]                       # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -245,9 +248,11 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
     BH, S, D = q.shape
     bq = _pick_block(S, block_q)
     bk = _pick_block(S, block_k)
-    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside pallas.
+    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA outside pallas;
+    # keepdims so the [BH, S, 1] layout matches lse's Mosaic-tileable
+    # trailing-singleton blocks.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                        # [BH, S]
+                    axis=-1, keepdims=True)         # [BH, S, 1]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -258,8 +263,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -276,8 +281,8 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),

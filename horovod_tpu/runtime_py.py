@@ -173,6 +173,7 @@ class _EngineBase:
         self.handles = HandleManager()
         self._pending_names: set = set()
         self._name_lock = threading.Lock()
+        self._barrier_counter = 0
 
     # -- duplicate-name guard (parity: tensor_queue.cc:27-35) -------------
 
@@ -448,9 +449,16 @@ class PyEngine(_EngineBase):
         return self._enqueue(entry)
 
     def barrier(self):
-        name = f"__barrier.{self.handles._next}"
+        # Dedicated per-engine barrier counter (NOT the handle counter):
+        # the name must be identical on every rank regardless of how many
+        # other ops each rank has issued, and wire-compatible with the
+        # native engine's naming (csrc/engine.cc Engine::Barrier).
+        with self._queue_lock:
+            name = f"__barrier.{self._barrier_counter}"
+            self._barrier_counter += 1
         req = Request(request_rank=self.rank,
                       request_type=RequestType.BARRIER,
+                      tensor_type=DataType.INT32,
                       tensor_name=name, device="cpu")
         h = self.handles.allocate()
         self._enqueue(TensorTableEntry(

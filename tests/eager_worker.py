@@ -372,7 +372,11 @@ SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
 
 
 def main():
-    name = sys.argv[1]
+    # One worker process can run a comma-separated batch of benign
+    # scenarios in a single engine lifetime (gang batching — amortizes
+    # the ~2.5 s interpreter+bootstrap cost per process on the test
+    # box); per-scenario markers let the test attribute failures.
+    names = sys.argv[1].split(",")
     hvd.init()
     expect_engine = os.environ.get("HVD_EXPECT_ENGINE")
     if expect_engine:
@@ -382,10 +386,29 @@ def main():
         assert got == expect_engine, (
             f"expected {expect_engine}, got {got} "
             f"(fallback: {getattr(basics._runtime, 'native_fallback_reason', None)})")
+    ok = True
     try:
-        SCENARIOS[name]()
+        for name in names:
+            try:
+                SCENARIOS[name]()
+                print(f"SCENARIO_OK {name}", flush=True)
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                print(f"SCENARIO_FAIL {name}", flush=True)
+                ok = False
+                # A failed scenario may have desynced the gang; stop
+                # rather than risk hanging the remaining scenarios.
+                break
     finally:
-        hvd.shutdown()
+        try:
+            hvd.shutdown()
+        except BaseException:
+            if ok:
+                raise
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
