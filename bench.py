@@ -72,11 +72,14 @@ def _timed_images_per_sec(step, state, images, labels, batch, iters,
         t0 = time.perf_counter()
         for _ in range(batches_per_iter):
             state, loss = step(state, images, labels)
-        # Host readback, not block_until_ready: a device→host transfer
-        # of the chain's final loss cannot complete before the chain
-        # has, which block_until_ready on the experimental tunnel
-        # platform occasionally (wrongly) does — it produced a
-        # physically impossible reading once.
+        # Host readback as the timing fence: a device→host transfer of
+        # the chain's final loss cannot complete before the chain has.
+        # One run on the experimental tunnel platform produced a
+        # physically impossible rate (>2x chip peak) with
+        # block_until_ready as the fence; whatever the transport/clock
+        # anomaly was, an actual data readback is the strictest sync
+        # available, and the median below bounds the damage of any
+        # remaining one-off.
         float(np.asarray(loss).ravel()[0])
         dt = time.perf_counter() - t0
         img_secs.append(batch * batches_per_iter / dt)

@@ -135,6 +135,20 @@ int64_t hvd_allgather_async(const char* name, const void* buf, int ndim,
   return h;
 }
 
+int64_t hvd_reducescatter_async(const char* name, const void* buf, int ndim,
+                                const int64_t* dims, int dtype, int op) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  std::string err;
+  int64_t h = g_engine->EnqueueReduceScatter(
+      name, buf, MakeShape(ndim, dims), static_cast<hvd::DataType>(dtype),
+      static_cast<hvd::ReduceOp>(op), &err);
+  if (h < 0) g_last_error = err;
+  return h;
+}
+
 int64_t hvd_broadcast_async(const char* name, void* buf, int ndim,
                             const int64_t* dims, int dtype, int root_rank) {
   if (!g_engine) {

@@ -420,6 +420,29 @@ int64_t Engine::EnqueueAlltoall(const std::string& name, const void* buf,
   return Enqueue(std::move(e), err);
 }
 
+int64_t Engine::EnqueueReduceScatter(const std::string& name,
+                                     const void* buf,
+                                     const TensorShape& shape, DataType dt,
+                                     ReduceOp op, std::string* err) {
+  if (shape.dims.empty()) {
+    *err = "reducescatter needs at least one dimension to scatter over "
+           "(got a scalar)";
+    return -1;
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.data = static_cast<uint8_t*>(const_cast<void*>(buf));
+  e.nelems = shape.num_elements();
+  e.handle = handles_.Allocate();
+  e.request.request_rank = cfg_.rank;
+  e.request.request_type = RequestType::REDUCESCATTER;
+  e.request.tensor_type = dt;
+  e.request.tensor_name = name;
+  e.request.tensor_shape = shape;
+  e.request.reduce_op = op;
+  return Enqueue(std::move(e), err);
+}
+
 int Engine::Barrier(std::string* err) {
   TensorTableEntry e;
   e.name = "__barrier." + std::to_string(barrier_counter_.fetch_add(1));
@@ -901,6 +924,18 @@ Response Engine::ConstructResponse(const std::string& name,
         break;
       }
     }
+  } else if (first.request_type == RequestType::REDUCESCATTER) {
+    if (mismatch([&](const Request& r) {
+          return r.tensor_shape != first.tensor_shape;
+        })) {
+      err = "Mismatched reducescatter tensor shapes for " + name;
+    } else if (mismatch([&](const Request& r) {
+                 return r.reduce_op != first.reduce_op;
+               })) {
+      err = "Mismatched reduce ops for tensor " + name;
+    } else if (first.reduce_op == ReduceOp::ADASUM) {
+      err = "Adasum is not defined for reducescatter (tensor " + name + ")";
+    }
   }
 
   if (!err.empty()) {
@@ -935,6 +970,10 @@ Response Engine::ConstructResponse(const std::string& name,
     }
   } else if (first.request_type == RequestType::BROADCAST) {
     resp.tensor_sizes = {first.root_rank};
+  } else if (first.request_type == RequestType::REDUCESCATTER) {
+    resp.tensor_sizes = {first.tensor_shape.num_elements()};
+    resp.reduce_op = first.reduce_op;
+    resp.tensor_shapes = {first.tensor_shape};
   }
   return resp;
 }
@@ -1011,6 +1050,15 @@ std::vector<TensorTableEntry> Engine::GetEntries(const Response& resp) {
         e.data = e.standin.data();
         e.nelems = n;
         e.request.tensor_shape.dims = {n};
+      } else if (resp.response_type == ResponseType::REDUCESCATTER) {
+        // Needs the negotiated shape — the scatter splits over dim 0,
+        // so a flat stand-in would desync the ring chunk boundaries.
+        const TensorShape& s = resp.tensor_shapes[i];
+        int64_t n = s.num_elements();
+        e.standin.assign(n * ItemSize(resp.tensor_type), 0);
+        e.data = e.standin.data();
+        e.nelems = n;
+        e.request.tensor_shape = s;
       } else {
         e.nelems = 0;
         e.request.tensor_shape.dims = {0};
@@ -1086,6 +1134,9 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
         break;
       case ResponseType::ALLTOALL:
         DoAlltoall(entries, resp);
+        break;
+      case ResponseType::REDUCESCATTER:
+        DoReduceScatter(entries, resp);
         break;
       case ResponseType::BARRIER:
         DoBarrier();
@@ -1517,6 +1568,61 @@ void Engine::DoAlltoall(std::vector<TensorTableEntry>& entries,
     if (e.handle >= 0)
       handles_.MarkDone(e.handle, Status::OK(), std::move(result),
                         std::move(recv_rows));
+  }
+}
+
+void Engine::DoReduceScatter(std::vector<TensorTableEntry>& entries,
+                             const Response& resp) {
+  // Ring reduce-scatter over dim-0 row chunks (parity:
+  // cpu_backend.reducescatter — identical walk, so mixed native/py jobs
+  // stay bit-compatible).  The standard walk leaves rank r owning chunk
+  // (r+1)%size; shifting the start by one virtual rank leaves it owning
+  // chunk r, which is the API contract.
+  int size = cfg_.size, rank = cfg_.rank;
+  DataType dt = resp.tensor_type;
+  size_t isz = ItemSize(dt);
+  ReduceOp op = resp.reduce_op;
+  for (auto& e : entries) {
+    const TensorShape& shape = e.request.tensor_shape;
+    int64_t d0 = shape.dims[0];
+    int64_t row_elems = d0 > 0 ? e.nelems / d0 : 0;
+    auto row_bounds = ChunkBounds(d0, size);
+    if (size == 1) {
+      std::vector<uint8_t> result(e.data, e.data + e.nelems * isz);
+      ReleaseName(e.name);
+      if (e.handle >= 0)
+        handles_.MarkDone(e.handle, Status::OK(), std::move(result));
+      continue;
+    }
+    // Working copies of each row chunk (the caller's input buffer is
+    // not mutated; the owned chunk becomes the handle result).
+    std::vector<std::vector<uint8_t>> chunks(size);
+    for (int i = 0; i < size; ++i) {
+      int64_t lo = row_bounds[i] * row_elems;
+      int64_t hi = row_bounds[i + 1] * row_elems;
+      chunks[i].assign(e.data + lo * isz, e.data + hi * isz);
+    }
+    int right = data_fds_[Mod(rank + 1, size)];
+    int left = data_fds_[Mod(rank - 1, size)];
+    std::vector<uint8_t> tmp;
+    for (int step = 0; step < size - 1; ++step) {
+      int64_t send_idx = Mod(rank - 1 - step, size);
+      int64_t recv_idx = Mod(rank - 2 - step, size);
+      tmp.resize(chunks[recv_idx].size());
+      ExchangeInto(right, chunks[send_idx].data(), chunks[send_idx].size(),
+                   left, tmp.data(), tmp.size());
+      CombineInto(chunks[recv_idx].data(), tmp.data(),
+                  static_cast<int64_t>(chunks[recv_idx].size() / isz), dt,
+                  op);
+    }
+    std::vector<uint8_t> result = std::move(chunks[rank]);
+    if (op == ReduceOp::AVERAGE)
+      AverageInPlace(result.data(),
+                     static_cast<int64_t>(result.size() / isz), dt,
+                     cfg_.size);
+    ReleaseName(e.name);
+    if (e.handle >= 0)
+      handles_.MarkDone(e.handle, Status::OK(), std::move(result));
   }
 }
 

@@ -185,6 +185,9 @@ class Engine {
                           const TensorShape& shape, DataType dt,
                           const std::vector<int64_t>& splits,
                           std::string* err);
+  int64_t EnqueueReduceScatter(const std::string& name, const void* buf,
+                               const TensorShape& shape, DataType dt,
+                               ReduceOp op, std::string* err);
 
   int Barrier(std::string* err);  // blocking; 0 ok
   int Join();                     // blocking; returns last joined rank
@@ -233,6 +236,8 @@ class Engine {
                    const Response& resp);
   void DoAlltoall(std::vector<TensorTableEntry>& entries,
                   const Response& resp);
+  void DoReduceScatter(std::vector<TensorTableEntry>& entries,
+                       const Response& resp);
   void DoBarrier();
 
   // Data plane.

@@ -72,6 +72,8 @@ from horovod_tpu.ops.eager import (  # noqa: F401
     grouped_allreduce,
     join,
     poll,
+    reducescatter,
+    reducescatter_async,
     sparse_allreduce,
     synchronize,
 )

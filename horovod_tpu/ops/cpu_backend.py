@@ -391,6 +391,52 @@ def _allgather_flat(engine, entries, resp: Response):
     return results
 
 
+def reducescatter(engine, entries, resp: Response):
+    """Ring reduce-scatter: reduce across ranks, scatter over dim 0.
+
+    Rank ``r`` receives the reduced rows ``bounds[r]:bounds[r+1]`` of an
+    NCCL-style near-equal row split (larger chunks on lower ranks, like
+    the reference project's later ``hvd.reducescatter``).  The ring walk
+    is the reduce-scatter phase of ``_ring_allreduce_group`` shifted by
+    one virtual rank so each rank finishes owning its own chunk; the
+    chunk boundaries align to dim-0 rows, not the flat element split.
+    """
+    size, rank = engine.size, engine.rank
+    op = resp.reduce_op
+    dtype = _np_dtype(resp.tensor_type)
+    results = []
+    for e in entries:
+        arr = np.ascontiguousarray(e.array).astype(dtype, copy=False)
+        d0 = arr.shape[0]
+        rest = arr.shape[1:]
+        bounds = _chunk_bounds(d0, size)
+        if size == 1:
+            results.append(arr.copy())
+            continue
+        chunks = [arr[bounds[i]:bounds[i + 1]].copy()
+                  for i in range(size)]
+        right = engine._data[(rank + 1) % size]
+        left = engine._data[(rank - 1) % size]
+        # Virtual rank (rank-1): the standard walk leaves rank r owning
+        # chunk (r+1)%size; shifting by one leaves it owning chunk r.
+        for step in range(size - 1):
+            send_idx = (rank - 1 - step) % size
+            recv_idx = (rank - 2 - step) % size
+            t = _send_async(right, chunks[send_idx].tobytes())
+            incoming = np.frombuffer(_recv(left), dtype=dtype).reshape(
+                (bounds[recv_idx + 1] - bounds[recv_idx],) + rest).copy()
+            t.join()
+            chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
+        out = chunks[rank]
+        if op == ReduceOp.AVERAGE:
+            if dtype.itemsize == 2:
+                out = (out.astype(np.float32) / size).astype(dtype)
+            else:
+                out = out / dtype.type(size)
+        results.append(out)
+    return results
+
+
 def broadcast(engine, entries, resp: Response):
     size, rank = engine.size, engine.rank
     results = []

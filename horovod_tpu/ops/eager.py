@@ -221,6 +221,35 @@ def sparse_allreduce(values, indices, average: Optional[bool] = None,
     return out_values, out_indices
 
 
+def reducescatter_async(tensor, average: Optional[bool] = None,
+                        name: Optional[str] = None,
+                        op: Optional[ReduceOp] = None) -> int:
+    """Reduce across ranks, scatter over dim 0 (rank r gets the r-th
+    near-equal row chunk).  The reference project added
+    ``hvd.reducescatter`` right after the v0.19 line; the in-graph twin
+    is ``ops.collective.reduce_scatter`` (``lax.psum_scatter``)."""
+    rop = _resolve_op(op, average)
+    if rop not in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.MIN,
+                   ReduceOp.MAX, ReduceOp.PRODUCT):
+        raise ValueError(f"reducescatter does not support op {rop}")
+    if np.ndim(tensor) == 0:
+        # Checked here, not just in the engines: _to_numpy lifts 0-d
+        # scalars to shape (1,) for the wire.
+        raise ValueError(
+            "reducescatter needs at least one dimension to scatter over "
+            "(got a scalar)")
+    arr, restore = _to_numpy(tensor)
+    h = basics._engine().reducescatter_async(
+        _auto_name("reducescatter", name), arr, op=rop)
+    return _register(h, restore)
+
+
+def reducescatter(tensor, average: Optional[bool] = None,
+                  name: Optional[str] = None,
+                  op: Optional[ReduceOp] = None):
+    return synchronize(reducescatter_async(tensor, average, name, op))
+
+
 def broadcast_async(tensor, root_rank: int = 0,
                     name: Optional[str] = None) -> int:
     arr, restore = _to_numpy(tensor)

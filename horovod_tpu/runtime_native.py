@@ -176,6 +176,23 @@ class NativeEngine:
                 RequestType.ALLGATHER, arr, dt, arr.shape)
         return h
 
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+        arr = np.ascontiguousarray(array)
+        if arr.ndim == 0:
+            raise ValueError(
+                "reducescatter needs at least one dimension to scatter "
+                "over (got a scalar)")
+        dt = dtype_from_numpy(arr.dtype)
+        nd, dims = self._dims(arr)
+        h = self._lib.hvd_reducescatter_async(
+            name.encode(), arr.ctypes.data, nd, dims, int(dt), int(op))
+        if h < 0:
+            self._raise_enqueue_error()
+        with self._meta_lock:
+            self._meta[h] = _HandleMeta(
+                RequestType.REDUCESCATTER, arr, dt, arr.shape)
+        return h
+
     def broadcast_async(self, name, array, root_rank=0):
         arr = np.ascontiguousarray(array)
         if arr is array:

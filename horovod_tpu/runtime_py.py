@@ -65,6 +65,7 @@ _OP_NAMES = {
     RequestType.ALLTOALL: "ALLTOALL",
     RequestType.JOIN: "JOIN",
     RequestType.BARRIER: "BARRIER",
+    RequestType.REDUCESCATTER: "REDUCESCATTER",
 }
 
 
@@ -233,6 +234,12 @@ class SingleProcessEngine(_EngineBase):
 
     def allgather_async(self, name, array):
         return self._finish(name, "ALLGATHER", np.asarray(array).copy())
+
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+        # size 1: the reduction of one rank's tensor, scattered to the
+        # one rank — the input itself.
+        return self._finish(name, "REDUCESCATTER",
+                            np.asarray(array).copy())
 
     def broadcast_async(self, name, array, root_rank=0):
         if root_rank != 0:
@@ -407,6 +414,24 @@ class PyEngine(_EngineBase):
             tensor_name=name,
             device="cpu",
             tensor_shape=TensorShape(arr.shape),
+        )
+        h = self.handles.allocate()
+        return self._enqueue(TensorTableEntry(name, arr, h, req))
+
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+        arr = np.ascontiguousarray(array)
+        if arr.ndim == 0:
+            raise ValueError(
+                "reducescatter needs at least one dimension to scatter "
+                "over (got a scalar)")
+        req = Request(
+            request_rank=self.rank,
+            request_type=RequestType.REDUCESCATTER,
+            tensor_type=dtype_from_numpy(arr.dtype),
+            tensor_name=name,
+            device="cpu",
+            tensor_shape=TensorShape(arr.shape),
+            reduce_op=op,
         )
         h = self.handles.allocate()
         return self._enqueue(TensorTableEntry(name, arr, h, req))
@@ -847,6 +872,17 @@ class PyEngine(_EngineBase):
                     err = (f"Mismatched allgather tensor shapes for {name}: "
                            f"all dimensions except the first must match")
                     break
+        elif first.request_type == RequestType.REDUCESCATTER:
+            if any(r.tensor_shape != first.tensor_shape for r in reqs):
+                err = (f"Mismatched reducescatter tensor shapes for "
+                       f"{name}: "
+                       + ", ".join(sorted({str(r.tensor_shape)
+                                           for r in reqs})))
+            elif any(r.reduce_op != first.reduce_op for r in reqs):
+                err = f"Mismatched reduce ops for tensor {name}"
+            elif first.reduce_op == ReduceOp.ADASUM:
+                err = (f"Adasum is not defined for reducescatter "
+                       f"(tensor {name})")
 
         if err is not None:
             return Response(response_type=ResponseType.ERROR,
@@ -874,6 +910,10 @@ class PyEngine(_EngineBase):
                 for r in range(self.size)]
         elif first.request_type == RequestType.BROADCAST:
             resp.tensor_sizes = [first.root_rank]
+        elif first.request_type == RequestType.REDUCESCATTER:
+            resp.tensor_sizes = [first.tensor_shape.num_elements]
+            resp.reduce_op = first.reduce_op
+            resp.tensor_shapes = [first.tensor_shape]
         return resp
 
     # -- fusion (parity: FuseResponses, controller.cc:638-759) -----------
@@ -928,6 +968,12 @@ class PyEngine(_EngineBase):
                     if resp.response_type == ResponseType.ALLREDUCE:
                         n = resp.tensor_sizes[i]
                         arr = np.zeros(n, dt)
+                    elif resp.response_type == ResponseType.REDUCESCATTER:
+                        # Needs the negotiated shape — the scatter splits
+                        # over dim 0, so a flat stand-in would desync the
+                        # ring chunk boundaries.
+                        arr = np.zeros(
+                            tuple(resp.tensor_shapes[i].dims), dt)
                     elif resp.response_type == ResponseType.ALLGATHER:
                         arr = np.zeros(0, dt)
                     else:
@@ -988,6 +1034,8 @@ class PyEngine(_EngineBase):
                 results = cpu_backend.broadcast(self, entries, resp)
             elif resp.response_type == ResponseType.ALLTOALL:
                 results = cpu_backend.alltoall(self, entries, resp)
+            elif resp.response_type == ResponseType.REDUCESCATTER:
+                results = cpu_backend.reducescatter(self, entries, resp)
             elif resp.response_type == ResponseType.BARRIER:
                 cpu_backend.barrier(self)
                 results = [None] * len(entries)

@@ -63,6 +63,8 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     grouped_allreduce_async,
     join,
     poll,
+    reducescatter,
+    reducescatter_async,
     synchronize,
 )
 from horovod_tpu.common.types import ReduceOp

@@ -200,6 +200,35 @@ def allgather(tensor, name=None) -> torch.Tensor:
                                    _auto_name("torch.allgather", name))
 
 
+def reducescatter_async(tensor, average=None, name=None, op=None) -> int:
+    """Reduce across ranks, scatter over dim 0 (rank r receives the r-th
+    near-equal row chunk; the reference project added torch
+    ``hvd.reducescatter`` right after the v0.19 line)."""
+    rop = _resolve_op(op, average)
+    if rop == ReduceOp.ADASUM:
+        raise ValueError("reducescatter does not support op Adasum")
+    if tensor.dim() == 0:
+        raise ValueError(
+            "reducescatter needs at least one dimension to scatter over "
+            "(got a scalar)")
+    arr = _to_numpy(tensor)
+    h = basics._engine().reducescatter_async(
+        _auto_name("torch.reducescatter", name), arr, op=rop)
+    tail_shape = tuple(tensor.shape[1:])
+
+    def finalize(result):
+        out = torch.from_numpy(np.asarray(result))
+        if tail_shape:
+            out = out.reshape(-1, *tail_shape)
+        return out.to(tensor.dtype).to(tensor.device)
+
+    return _register(h, finalize)
+
+
+def reducescatter(tensor, average=None, name=None, op=None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, average, name, op))
+
+
 # ---------------------------------------------------------------------------
 # broadcast
 # ---------------------------------------------------------------------------

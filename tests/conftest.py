@@ -83,7 +83,8 @@ _SLOW_NODEIDS = (
 # Multiprocess matrix: non-native engine variants are wire-compatibility
 # re-runs of the same scenario; keep `mixed` coverage on test_allreduce
 # and test_hierarchical_vs_flat, prune the rest by default.
-_ENGINE_MATRIX_KEEP = ("test_allreduce", "test_hierarchical_vs_flat")
+_ENGINE_MATRIX_KEEP = ("test_allreduce", "test_hierarchical_vs_flat",
+                       "test_reducescatter")
 
 
 def pytest_collection_modifyitems(config, items):

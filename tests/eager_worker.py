@@ -107,6 +107,37 @@ def scenario_allgather():
     np.testing.assert_array_equal(out, expect)
 
 
+def scenario_reducescatter():
+    rank, size = hvd.rank(), hvd.size()
+    # Uneven dim 0 (2*size+1 rows): NCCL-style near-equal split gives the
+    # low ranks the extra row.  Every rank contributes rank+1 times the
+    # row index, so the reduced tensor is analytic.
+    d0 = 2 * size + 1
+    x = np.outer(np.arange(d0, dtype=np.float32) + 1,
+                 np.ones(3, np.float32)) * (rank + 1)
+    out = hvd.reducescatter(x, op=hvd.Sum, name="rs.sum")
+    total = size * (size + 1) // 2
+    base, rem = divmod(d0, size)
+    lo = rank * base + min(rank, rem)
+    hi = lo + base + (1 if rank < rem else 0)
+    expect = np.outer(np.arange(lo, hi, dtype=np.float32) + 1,
+                      np.ones(3, np.float32)) * total
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    assert out.shape == (hi - lo, 3), out.shape
+    # Average divides by size; 1-D and int dtypes ride the same path.
+    out = hvd.reducescatter(x, op=hvd.Average, name="rs.avg")
+    np.testing.assert_allclose(out, expect / size, rtol=1e-6)
+    xi = (np.arange(size * 2, dtype=np.int64) + rank)
+    out = hvd.reducescatter(xi, op=hvd.Sum, name="rs.int")
+    lo_i = rank * 2
+    expect_i = (np.arange(lo_i, lo_i + 2, dtype=np.int64) * size
+                + size * (size - 1) // 2)
+    np.testing.assert_array_equal(out, expect_i)
+    # Max: elementwise maximum across ranks, then scatter.
+    out = hvd.reducescatter(x, op=hvd.Max, name="rs.max")
+    np.testing.assert_allclose(out, expect / total * size, rtol=1e-6)
+
+
 def scenario_sparse_allreduce():
     rank, size = hvd.rank(), hvd.size()
     # Each rank touches an overlapping, ragged set of embedding rows

@@ -56,6 +56,17 @@ def test_allgather_broadcast_alltoall():
         hvd.broadcast(x, root_rank=3)
 
 
+def test_reducescatter_single():
+    # size 1: the reduction of one rank's tensor, scattered to the one
+    # rank — identity.  Scalars and unsupported ops are named errors.
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    np.testing.assert_array_equal(hvd.reducescatter(x, op=hvd.Sum), x)
+    with pytest.raises(ValueError, match="at least one dimension"):
+        hvd.reducescatter(np.float32(1.0), op=hvd.Sum)
+    with pytest.raises(ValueError, match="does not support"):
+        hvd.reducescatter(x, op=hvd.Adasum)
+
+
 def test_join_and_barrier():
     assert hvd.join() == 0
     hvd.barrier()

@@ -105,7 +105,7 @@ _GANG_SCENARIOS = {
     (2, "plain"): ["allreduce", "fusion", "allgather", "barrier",
                    "resume_or_init"],
     (3, "plain"): ["allgather", "broadcast", "sparse_allreduce",
-                   "alltoall"],
+                   "alltoall", "reducescatter"],
     (4, "plain"): ["allreduce", "adasum"],
     # np=4 as 2 nodes × 2 local ranks; the same op-semantics scenarios
     # must pass with the two-level data plane, and hier_vs_flat pins the
@@ -205,6 +205,12 @@ def test_sparse_allreduce(engine):
 @pytest.mark.parametrize("engine", ENGINES)
 def test_alltoall(engine):
     assert_gang("alltoall", 3, engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_reducescatter(engine):
+    # mixed included: the ring walk must be identical across engines.
+    assert_gang("reducescatter", 3, engine)
 
 
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])

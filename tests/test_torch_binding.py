@@ -132,6 +132,15 @@ class TestSingleProcess:
         assert torch.equal(out, torch.arange(4.0))
         assert recv.tolist() == [4]
 
+    def test_reducescatter_size1(self, hvd1):
+        x = torch.arange(6.0).reshape(3, 2)
+        out = hvd1.reducescatter(x, op=hvd1.Sum, name="s.rs")
+        assert torch.equal(out, x)
+        with pytest.raises(ValueError, match="Adasum"):
+            hvd1.reducescatter(x, op=hvd1.Adasum)
+        with pytest.raises(ValueError, match="at least one dimension"):
+            hvd1.reducescatter(torch.tensor(1.0))
+
 
 # -- multi-process --------------------------------------------------------
 
