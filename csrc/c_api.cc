@@ -17,6 +17,7 @@
 #include <vector>
 
 #include "engine.h"
+#include "kernels.h"
 
 namespace {
 
@@ -133,6 +134,21 @@ int64_t hvd_allgather_async(const char* name, const void* buf, int ndim,
                                          &err);
   if (h < 0) g_last_error = err;
   return h;
+}
+
+// Test-only conversion surface: lets the Python tests pin the fp8
+// codecs bit-for-bit against ml_dtypes (mixed native/py jobs rely on
+// the two sides converting identically).  kind: 0 = e4m3fn, 1 = e5m2.
+void hvd_fp8_to_f32(int kind, const uint8_t* in, float* out, int n) {
+  for (int i = 0; i < n; ++i)
+    out[i] = kind == 0 ? hvd::Fp8E4m3ToFloat(in[i])
+                       : hvd::Fp8E5m2ToFloat(in[i]);
+}
+
+void hvd_f32_to_fp8(int kind, const float* in, uint8_t* out, int n) {
+  for (int i = 0; i < n; ++i)
+    out[i] = kind == 0 ? hvd::FloatToFp8E4m3(in[i])
+                       : hvd::FloatToFp8E5m2(in[i]);
 }
 
 int64_t hvd_reducescatter_async(const char* name, const void* buf, int ndim,

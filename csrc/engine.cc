@@ -56,6 +56,8 @@ const char* DtypeName(DataType t) {
     case DataType::FLOAT64: return "FLOAT64";
     case DataType::BOOL: return "BOOL";
     case DataType::BFLOAT16: return "BFLOAT16";
+    case DataType::FLOAT8_E4M3: return "FLOAT8_E4M3";
+    case DataType::FLOAT8_E5M2: return "FLOAT8_E5M2";
   }
   return "?";
 }

@@ -14,14 +14,16 @@ float HalfToFloat(uint16_t h) {
   if (exp == 0) {
     if (mant == 0) {
       out = sign;
-    } else {  // subnormal: normalize
+    } else {  // subnormal: value = mant * 2^-24; normalize
       int shift = 0;
       while (!(mant & 0x400u)) {
         mant <<= 1;
         ++shift;
       }
       mant &= 0x3ffu;
-      out = sign | ((112 - shift) << 23) | (mant << 13);
+      // Leading bit at 2^10 after `shift` shifts -> value
+      // (1+frac) * 2^(-14-shift) -> float exp field 113-shift.
+      out = sign | ((113 - shift) << 23) | (mant << 13);
     }
   } else if (exp == 31) {
     out = sign | 0x7f800000u | (mant << 13);
@@ -70,6 +72,95 @@ uint16_t FloatToBf16(float f) {
   uint32_t lsb = (u >> 16) & 1;
   u += 0x7fffu + lsb;  // round to nearest even
   return static_cast<uint16_t>(u >> 16);
+}
+
+float Fp8E4m3ToFloat(uint8_t v) {
+  uint32_t sign = static_cast<uint32_t>(v & 0x80u) << 24;
+  uint32_t exp = (v >> 3) & 0xfu;
+  uint32_t mant = v & 0x7u;
+  uint32_t out;
+  if (exp == 0xf && mant == 0x7) {
+    out = sign | 0x7fc00000u;  // NaN (e4m3fn has no inf)
+  } else if (exp == 0) {
+    if (mant == 0) {
+      out = sign;
+    } else {  // subnormal: value = mant/8 * 2^-6; normalize
+      int shift = 0;
+      while (!(mant & 0x8u)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x7u;
+      out = sign | ((121 - shift) << 23) | (mant << 20);
+    }
+  } else {
+    out = sign | ((exp + 120) << 23) | (mant << 20);  // bias 7 -> 127
+  }
+  float f;
+  std::memcpy(&f, &out, 4);
+  return f;
+}
+
+uint8_t FloatToFp8E4m3(float f) {
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint8_t sign = static_cast<uint8_t>((u >> 24) & 0x80u);
+  if ((u & 0x7f800000u) == 0x7f800000u)
+    return sign | 0x7f;  // inf and NaN both map to NaN (ml_dtypes)
+  int32_t exp = static_cast<int32_t>((u >> 23) & 0xff) - 127 + 7;
+  uint32_t mant = u & 0x7fffffu;
+  if (exp >= 16) return sign | 0x7f;  // beyond rounding range -> NaN
+  if (exp <= 0) {  // subnormal target (quantum 2^-9) or underflow
+    if (exp < -3) return sign;
+    mant |= 0x800000u;
+    int shift = 21 - exp;  // note 21 - exp <= 24 here
+    uint32_t sub = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (sub & 1))) ++sub;  // RNE
+    return static_cast<uint8_t>(sign | sub);
+  }
+  uint32_t mag = (static_cast<uint32_t>(exp) << 3) | (mant >> 20);
+  uint32_t rem = mant & 0xfffffu;
+  if (rem > 0x80000u || (rem == 0x80000u && (mag & 1))) ++mag;  // RNE
+  // Rounding into (or past) exp 15 / mant 7 is the NaN encoding —
+  // ml_dtypes' overflow-to-NaN for values > 448.  The clamp matters for
+  // |f| in [496, 512): there the carry would otherwise run past bit 7
+  // and corrupt the sign (encode +/-0.0 instead of NaN).
+  if (mag > 0x7fu) mag = 0x7fu;
+  return static_cast<uint8_t>(sign | mag);
+}
+
+uint8_t FloatToFp8E5m2(float f) {
+  // Single-step rounding from f32 (routing through fp16 first would
+  // double-round: e.g. 52.004 -> half 52.0 -> ties-even 48, where the
+  // one-step nearest e5m2 value is 56, which is what ml_dtypes gives).
+  uint32_t u;
+  std::memcpy(&u, &f, 4);
+  uint8_t sign = static_cast<uint8_t>((u >> 24) & 0x80u);
+  uint32_t absu = u & 0x7fffffffu;
+  if (absu > 0x7f800000u) return sign | 0x7e;  // NaN (quieted)
+  if (absu == 0x7f800000u) return sign | 0x7c;  // inf
+  int32_t exp = static_cast<int32_t>((u >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = u & 0x7fffffu;
+  if (exp >= 31) return sign | 0x7c;  // overflow -> inf
+  if (exp <= 0) {  // subnormal target (quantum 2^-16) or underflow
+    if (exp < -8) return sign;
+    mant |= 0x800000u;
+    int shift = 22 - exp;
+    uint32_t sub = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half = 1u << (shift - 1);
+    if (rem > half || (rem == half && (sub & 1))) ++sub;  // RNE
+    return static_cast<uint8_t>(sign | sub);
+  }
+  uint32_t out = static_cast<uint32_t>(sign) |
+                 (static_cast<uint32_t>(exp) << 2) | (mant >> 21);
+  uint32_t rem = mant & 0x1fffffu;
+  if (rem > 0x100000u || (rem == 0x100000u && (out & 1))) ++out;  // RNE
+  // Rounding carry rolls exp 30/mant 3 into the inf encoding, matching
+  // one-step nearest conversion for values above the max finite 57344.
+  return static_cast<uint8_t>(out);
 }
 
 namespace {
@@ -183,6 +274,22 @@ void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
             CombineF32(Bf16ToFloat(s[i]), Bf16ToFloat(d[i]), op));
       break;
     }
+    case DataType::FLOAT8_E4M3: {
+      auto* d = static_cast<uint8_t*>(dst);
+      auto* s = static_cast<const uint8_t*>(incoming);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToFp8E4m3(
+            CombineF32(Fp8E4m3ToFloat(s[i]), Fp8E4m3ToFloat(d[i]), op));
+      break;
+    }
+    case DataType::FLOAT8_E5M2: {
+      auto* d = static_cast<uint8_t*>(dst);
+      auto* s = static_cast<const uint8_t*>(incoming);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToFp8E5m2(
+            CombineF32(Fp8E5m2ToFloat(s[i]), Fp8E5m2ToFloat(d[i]), op));
+      break;
+    }
   }
 }
 
@@ -216,6 +323,20 @@ void ScaleInPlace(void* buf, size_t n, DataType dt, double factor) {
       float f = static_cast<float>(factor);
       for (size_t i = 0; i < n; ++i)
         b[i] = FloatToBf16(Bf16ToFloat(b[i]) * f);
+      break;
+    }
+    case DataType::FLOAT8_E4M3: {
+      auto* b = static_cast<uint8_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToFp8E4m3(Fp8E4m3ToFloat(b[i]) * f);
+      break;
+    }
+    case DataType::FLOAT8_E5M2: {
+      auto* b = static_cast<uint8_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToFp8E5m2(Fp8E5m2ToFloat(b[i]) * f);
       break;
     }
     case DataType::INT32: {
@@ -285,6 +406,20 @@ void AverageInPlace(void* buf, size_t n, DataType dt, int64_t world_size) {
       float inv = static_cast<float>(world_size);
       for (size_t i = 0; i < n; ++i)
         b[i] = FloatToBf16(Bf16ToFloat(b[i]) / inv);
+      break;
+    }
+    case DataType::FLOAT8_E4M3: {
+      auto* b = static_cast<uint8_t*>(buf);
+      float inv = static_cast<float>(world_size);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToFp8E4m3(Fp8E4m3ToFloat(b[i]) / inv);
+      break;
+    }
+    case DataType::FLOAT8_E5M2: {
+      auto* b = static_cast<uint8_t*>(buf);
+      float inv = static_cast<float>(world_size);
+      for (size_t i = 0; i < n; ++i)
+        b[i] = FloatToFp8E5m2(Fp8E5m2ToFloat(b[i]) / inv);
       break;
     }
     case DataType::FLOAT32: {
@@ -375,6 +510,16 @@ void ToF64(const void* src, double* dst, size_t n, DataType dt) {
       for (size_t i = 0; i < n; ++i) dst[i] = s[i] ? 1.0 : 0.0;
       break;
     }
+    case DataType::FLOAT8_E4M3: {
+      auto* s = static_cast<const uint8_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = Fp8E4m3ToFloat(s[i]);
+      break;
+    }
+    case DataType::FLOAT8_E5M2: {
+      auto* s = static_cast<const uint8_t*>(src);
+      for (size_t i = 0; i < n; ++i) dst[i] = Fp8E5m2ToFloat(s[i]);
+      break;
+    }
   }
 }
 
@@ -433,6 +578,18 @@ void FromF64(const double* src, void* dst, size_t n, DataType dt) {
     case DataType::BOOL: {
       auto* d = static_cast<uint8_t*>(dst);
       for (size_t i = 0; i < n; ++i) d[i] = src[i] != 0.0;
+      break;
+    }
+    case DataType::FLOAT8_E4M3: {
+      auto* d = static_cast<uint8_t*>(dst);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToFp8E4m3(static_cast<float>(src[i]));
+      break;
+    }
+    case DataType::FLOAT8_E5M2: {
+      auto* d = static_cast<uint8_t*>(dst);
+      for (size_t i = 0; i < n; ++i)
+        d[i] = FloatToFp8E5m2(static_cast<float>(src[i]));
       break;
     }
   }

@@ -29,6 +29,17 @@ inline float Bf16ToFloat(uint16_t b) {
 }
 uint16_t FloatToBf16(float f);
 
+// OCP FP8 <-> fp32 (round-to-nearest-even, matching ml_dtypes so mixed
+// native/py jobs stay bit-compatible).  e4m3fn: no inf, 0x7f = NaN,
+// overflow beyond the rounding range of ±448 -> NaN (ml_dtypes
+// semantics).  e5m2 is fp16 truncated to its top byte.
+float Fp8E4m3ToFloat(uint8_t v);
+uint8_t FloatToFp8E4m3(float f);
+inline float Fp8E5m2ToFloat(uint8_t v) {
+  return HalfToFloat(static_cast<uint16_t>(v) << 8);
+}
+uint8_t FloatToFp8E5m2(float f);
+
 // dst[i] = combine(incoming[i], dst[i]) for n elements of dtype dt.
 // Argument order matches the Python engine's `_combine(incoming, chunk)`
 // so mixed-engine jobs reduce identically.

@@ -24,6 +24,10 @@ enum class DataType : uint8_t {
   FLOAT64 = 8,
   BOOL = 9,
   BFLOAT16 = 10,
+  // OCP FP8 wire formats (TPU-native extension; ring hops accumulate
+  // via fp32 like half.cc — see kernels.cc Fp8* conversions).
+  FLOAT8_E4M3 = 11,
+  FLOAT8_E5M2 = 12,
 };
 
 inline size_t ItemSize(DataType dt) {
@@ -31,6 +35,8 @@ inline size_t ItemSize(DataType dt) {
     case DataType::UINT8:
     case DataType::INT8:
     case DataType::BOOL:
+    case DataType::FLOAT8_E4M3:
+    case DataType::FLOAT8_E5M2:
       return 1;
     case DataType::UINT16:
     case DataType::INT16:

@@ -31,6 +31,11 @@ class DataType(enum.IntEnum):
     FLOAT64 = 8
     BOOL = 9
     BFLOAT16 = 10
+    # TPU-native 8-bit wire formats (beyond the reference, which stops at
+    # fp16): OCP FP8 — e4m3fn for gradients, e5m2 (truncated fp16) for
+    # range-heavy tensors.  Ring hops accumulate via fp32 like half.cc.
+    FLOAT8_E4M3 = 11
+    FLOAT8_E5M2 = 12
 
     @property
     def itemsize(self) -> int:
@@ -49,6 +54,8 @@ _ITEMSIZE = {
     DataType.FLOAT64: 8,
     DataType.BOOL: 1,
     DataType.BFLOAT16: 2,
+    DataType.FLOAT8_E4M3: 1,
+    DataType.FLOAT8_E5M2: 1,
 }
 
 _NUMPY_NAMES = {
@@ -63,6 +70,8 @@ _NUMPY_NAMES = {
     DataType.FLOAT64: "float64",
     DataType.BOOL: "bool",
     DataType.BFLOAT16: "bfloat16",
+    DataType.FLOAT8_E4M3: "float8_e4m3fn",
+    DataType.FLOAT8_E5M2: "float8_e5m2",
 }
 
 

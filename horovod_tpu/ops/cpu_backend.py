@@ -52,9 +52,17 @@ def _recv(sock) -> bytes:
     return payload
 
 
+def _needs_f32_math(dtype: np.dtype) -> bool:
+    """Sub-32-bit floats (fp16/bf16/fp8) do their arithmetic in fp32,
+    like half.cc."""
+    return dtype.name in ("float16", "bfloat16", "float8_e4m3fn",
+                          "float8_e5m2")
+
+
 def _combine(a: np.ndarray, b: np.ndarray, op: ReduceOp) -> np.ndarray:
-    """Per-hop reduction; 16-bit inputs accumulate via fp32 like half.cc."""
-    if a.dtype.name in ("float16", "bfloat16"):
+    """Per-hop reduction; sub-32-bit floats accumulate via fp32 like
+    half.cc (fp8 wire formats included)."""
+    if _needs_f32_math(a.dtype):
         a32, b32 = a.astype(np.float32), b.astype(np.float32)
         out = _combine(a32, b32, op)
         return out.astype(a.dtype)
@@ -260,13 +268,16 @@ def allreduce(engine, entries, resp: Response):
     flats = [np.ravel(e.array).astype(dtype, copy=False) for e in entries]
     flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
     if prescale != 1.0:
-        flat = flat * dtype.type(prescale)
+        if _needs_f32_math(dtype):
+            flat = (flat.astype(np.float32) * prescale).astype(dtype)
+        else:
+            flat = flat * dtype.type(prescale)
 
     reduced = next(c for c in ALLREDUCE_CHAIN
                    if c.enabled(engine, resp)).execute(engine, flat, op)
 
     if op == ReduceOp.AVERAGE:
-        if dtype.itemsize == 2:
+        if _needs_f32_math(dtype):
             reduced = (reduced.astype(np.float32) / engine.size).astype(dtype)
         else:
             reduced = reduced / dtype.type(engine.size)
@@ -429,7 +440,7 @@ def reducescatter(engine, entries, resp: Response):
             chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
         out = chunks[rank]
         if op == ReduceOp.AVERAGE:
-            if dtype.itemsize == 2:
+            if _needs_f32_math(dtype):
                 out = (out.astype(np.float32) / size).astype(dtype)
             else:
                 out = out / dtype.type(size)

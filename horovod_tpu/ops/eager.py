@@ -138,12 +138,20 @@ def allreduce_async(tensor, average: Optional[bool] = None,
 
 
 def _np_compress(compression, arr):
+    import ml_dtypes
+
     from horovod_tpu.ops import compression as C
 
     if compression is C.Compression.none or compression is C.NoneCompressor:
         return arr, None
-    wire = np.dtype("float16") if compression is C.Float16Compressor \
-        else _bf16_dtype()
+    if compression is C.Float16Compressor:
+        wire = np.dtype("float16")
+    elif compression is C.Float8Compressor:
+        wire = np.dtype(ml_dtypes.float8_e4m3fn)
+    elif compression is C.Float8E5M2Compressor:
+        wire = np.dtype(ml_dtypes.float8_e5m2)
+    else:
+        wire = _bf16_dtype()
     if arr.dtype.kind == "f" and arr.dtype != wire:
         return arr.astype(wire), arr.dtype
     return arr, None

@@ -152,10 +152,10 @@ class _MessageTable:
 
 def _np_dtype(dt: DataType):
     name = dtype_to_numpy_name(dt)
-    if name == "bfloat16":
+    if name in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
         import ml_dtypes
 
-        return np.dtype(ml_dtypes.bfloat16)
+        return np.dtype(getattr(ml_dtypes, name))
     return np.dtype(name)
 
 

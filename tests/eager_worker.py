@@ -53,6 +53,22 @@ def scenario_allreduce():
     out = hvd.allreduce(x, op=hvd.Sum, name="ar.bf16")
     np.testing.assert_allclose(
         out.astype(np.float64), np.full(8, sum(r + 1.0 for r in range(size))))
+    # fp8 wire formats (TPU-native extension): small exact values so the
+    # sum is representable; mixed gangs pin native<->py codec parity.
+    for dt8 in (ml_dtypes.float8_e4m3fn, ml_dtypes.float8_e5m2):
+        x = np.ones(8, dt8) * (rank + 1)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.{np.dtype(dt8).name}")
+        np.testing.assert_allclose(
+            out.astype(np.float64),
+            np.full(8, sum(r + 1.0 for r in range(size))))
+    # fp8 as compression: fp32 in, e4m3 on the wire, fp32 back.
+    from horovod_tpu.ops.compression import Compression
+
+    x = np.full(6, 0.25 * (rank + 1), np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, name="ar.fp8c",
+                        compression=Compression.fp8)
+    np.testing.assert_allclose(
+        out, np.full(6, 0.25 * sum(r + 1 for r in range(size))), rtol=1e-6)
 
 
 def scenario_fusion():

@@ -1,0 +1,105 @@
+"""FP8 wire-format tests: codec parity and compression round trips.
+
+The native and Python engines must convert fp8 identically (mixed jobs
+reduce bit-for-bit); the Python side is ml_dtypes, so the C++ codecs in
+``csrc/kernels.cc`` are pinned against ml_dtypes exhaustively — every
+one of the 256 codes decoded, and a large random float grid encoded.
+"""
+
+import ctypes
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.compression import Compression
+
+
+def _codec(lib):
+    lib.hvd_fp8_to_f32.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.hvd_f32_to_fp8.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+    return lib
+
+
+@pytest.fixture(scope="module")
+def lib():
+    from horovod_tpu import native
+
+    try:
+        return _codec(native.load())
+    except Exception:
+        pytest.skip("native core unavailable")
+
+
+@pytest.mark.parametrize("kind,dt", [(0, ml_dtypes.float8_e4m3fn),
+                                     (1, ml_dtypes.float8_e5m2)])
+def test_fp8_decode_matches_ml_dtypes(lib, kind, dt):
+    codes = np.arange(256, dtype=np.uint8)
+    ref = codes.view(dt).astype(np.float32)
+    out = np.empty(256, np.float32)
+    lib.hvd_fp8_to_f32(
+        kind, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 256)
+    nan = np.isnan(ref)
+    np.testing.assert_array_equal(nan, np.isnan(out))
+    np.testing.assert_array_equal(ref[~nan], out[~nan])
+
+
+@pytest.mark.parametrize("kind,dt", [(0, ml_dtypes.float8_e4m3fn),
+                                     (1, ml_dtypes.float8_e5m2)])
+def test_fp8_encode_matches_ml_dtypes(lib, kind, dt):
+    rs = np.random.RandomState(0)
+    f = np.concatenate([
+        rs.randn(50000).astype(np.float32) * 100,
+        rs.randn(50000).astype(np.float32) * 1e-3,
+        rs.randn(20000).astype(np.float32) * 1e-6,
+        np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 448.0, 449.0,
+                  1000.0, -448.0, 57344.0, 61440.0, 65000.0, 2**-9,
+                  2**-10, 2**-16, 2**-17, 5.7e-10,
+                  # e4m3 carry window [496, 512): the RNE carry at
+                  # exp 15 / mant 7 must clamp to NaN, not run into the
+                  # sign bit (regression).
+                  496.0, 500.0, -500.0, 511.99, -496.0, 480.0,
+                  465.0], np.float32)])
+    ref = f.astype(dt).view(np.uint8)
+    out = np.empty(len(f), np.uint8)
+    lib.hvd_f32_to_fp8(
+        kind, f.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(f))
+    reff = ref.view(dt).astype(np.float32)
+    outf = out.view(dt).astype(np.float32)
+    nan = np.isnan(reff)
+    np.testing.assert_array_equal(nan, np.isnan(outf))
+    np.testing.assert_array_equal(ref[~nan], out[~nan])
+
+
+def test_fp16_subnormal_decode(lib):
+    """Regression: HalfToFloat's subnormal path was off by a factor of 2
+    (exp field 112 instead of 113), caught by pinning the e5m2 decode
+    (a truncated fp16) against ml_dtypes."""
+    codes = np.array([1, 2, 3], dtype=np.uint8)  # e5m2 subnormals
+    out = np.empty(3, np.float32)
+    lib.hvd_fp8_to_f32(
+        1, codes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 3)
+    np.testing.assert_array_equal(
+        out, np.array([2**-16, 2**-15, 3 * 2**-16], np.float32))
+
+
+def test_fp8_compression_single():
+    hvd.init()
+    x = np.full(5, 0.3, np.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, name="fp8.single",
+                        compression=Compression.fp8)
+    # size 1: value passes through the e4m3 grid once (0.3 -> 0.3125)
+    # and comes back as fp32.
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, 0.3125)
+    out = hvd.allreduce(x, op=hvd.Sum, name="fp8.e5m2",
+                        compression=Compression.fp8_e5m2)
+    np.testing.assert_allclose(out, 0.3125)
