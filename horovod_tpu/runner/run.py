@@ -58,6 +58,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "(parity: horovodrun's gloo/jsrun modes)")
     p.add_argument("--start-timeout", type=int, default=120,
                    dest="start_timeout")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   dest="max_restarts",
+                   help="restart-based elasticity: relaunch the whole "
+                        "gang up to N times when any rank fails "
+                        "(training scripts resume from their checkpoint; "
+                        "the TPU-native form of elastic training — pod "
+                        "meshes restart, they do not re-form). Default "
+                        "0 keeps the reference's fail-fast contract.")
     p.add_argument("--disable-cache", action="store_true",
                    dest="disable_cache")
     p.add_argument("--output-filename", dest="output_filename")
@@ -182,6 +190,12 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         output = open(args.output_filename, "w")
     try:
         if args.launcher == "jsrun":
+            if args.max_restarts:
+                print("hvdrun: --max-restarts is not supported with "
+                      "--launcher jsrun (the LSF scheduler owns the "
+                      "job lifecycle; use its requeue policy)",
+                      file=sys.stderr)
+                return 2
             # One jsrun fan-out: tasks get rank/size from PMIX env
             # (discovery.from_mpi_env) and rendezvous back here; the
             # coordinates + secret ride the process environment.
@@ -196,13 +210,30 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             return subprocess.run(
                 lsf.jsrun_command(args.np, command), env=env,
                 stdout=output or None).returncode
-        launch_workers(
-            slots, command, addr, port,
-            env_extra=env_extra,
-            ssh_port=args.ssh_port,
-            ssh_identity_file=args.ssh_identity_file,
-            output=output)
-        return 0
+        from horovod_tpu.runner.launch import LaunchError
+
+        for attempt in range(args.max_restarts + 1):
+            env_try = dict(env_extra)
+            if attempt:
+                # Scoped rendezvous keys: the relaunched gang must never
+                # read the dead attempt's stale addresses.
+                env_try["HVD_RDV_SCOPE"] = f"attempt{attempt}"
+            try:
+                launch_workers(
+                    slots, command, addr, port,
+                    env_extra=env_try,
+                    ssh_port=args.ssh_port,
+                    ssh_identity_file=args.ssh_identity_file,
+                    output=output)
+                return 0
+            except LaunchError as e:
+                if attempt >= args.max_restarts:
+                    raise
+                print(f"hvdrun: rank {e.rank} exited with code "
+                      f"{e.returncode}; restarting the job "
+                      f"(attempt {attempt + 1}/{args.max_restarts})",
+                      file=sys.stderr)
+        raise AssertionError("unreachable: loop returns or raises")
     finally:
         if output is not None:
             output.close()

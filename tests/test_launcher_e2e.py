@@ -69,6 +69,51 @@ def test_cli_fail_fast(tmp_path):
     assert "exited with code 3" in res.stdout + res.stderr
 
 
+CRASH_ONCE_WORKER = textwrap.dedent("""\
+    import os, sys
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.rank()
+    sentinel = os.environ["SENTINEL"]
+    if r == 1 and not os.path.exists(sentinel):
+        open(sentinel, "w").close()
+        sys.exit(5)  # first attempt: rank 1 dies mid-job
+    out = hvd.allreduce(np.full(3, float(r + 1)), name="e", average=False)
+    assert np.allclose(out, sum(range(1, hvd.size() + 1))), out
+    print(f"rank {r} resumed ok (attempt 2)")
+    hvd.shutdown()
+""")
+
+
+def test_cli_max_restarts_relaunches(tmp_path):
+    """Restart-based elasticity: a rank failure with --max-restarts
+    relaunches the whole gang under a fresh rendezvous scope; the second
+    attempt bootstraps cleanly and the job exits 0."""
+    env_sentinel = str(tmp_path / "crashed_once")
+    prog = tmp_path / "prog.py"
+    prog.write_text(CRASH_ONCE_WORKER)
+    env = dict(os.environ, SENTINEL=env_sentinel)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run",
+         "-np", "2", "--max-restarts", "2",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "restarting the job (attempt 1/2)" in res.stderr, res.stderr
+    assert "rank 0 resumed ok" in res.stdout
+    assert "rank 1 resumed ok" in res.stdout
+    # Without restarts the same crash keeps the fail-fast contract.
+    os.remove(env_sentinel)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run",
+         "-np", "2", sys.executable, str(prog)],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert res.returncode != 0
+
+
 def test_run_func_mode():
     from horovod_tpu.runner import run as run_mod
 
