@@ -136,6 +136,15 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if not args.command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2
+    if args.max_restarts < 0:
+        print("hvdrun: --max-restarts must be >= 0 (there is no "
+              "infinite-restart sentinel; pick a bound)", file=sys.stderr)
+        return 2
+    if args.max_restarts and args.launcher == "jsrun":
+        print("hvdrun: --max-restarts is not supported with "
+              "--launcher jsrun (the LSF scheduler owns the job "
+              "lifecycle; use its requeue policy)", file=sys.stderr)
+        return 2
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -190,12 +199,6 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         output = open(args.output_filename, "w")
     try:
         if args.launcher == "jsrun":
-            if args.max_restarts:
-                print("hvdrun: --max-restarts is not supported with "
-                      "--launcher jsrun (the LSF scheduler owns the "
-                      "job lifecycle; use its requeue policy)",
-                      file=sys.stderr)
-                return 2
             # One jsrun fan-out: tasks get rank/size from PMIX env
             # (discovery.from_mpi_env) and rendezvous back here; the
             # coordinates + secret ride the process environment.

@@ -142,6 +142,37 @@ def allgather(tensor, name=None):
     return _fn(x)
 
 
+def reducescatter(tensor, average=None, name=None, op=None):
+    """Differentiable reducescatter: reduce across ranks, scatter over
+    dim 0 (rank r receives the r-th near-equal row chunk; the reference
+    project added ``hvd.reducescatter`` right after the v0.19 line).
+    Backward is the allgather of the per-rank chunk gradients (scaled by
+    1/size for Average), mirroring the reference's grad registration."""
+    nm = _auto_name("tf.reducescatter", name)
+    x = tf.convert_to_tensor(tensor)
+    rop = _resolve_op(op, average)
+
+    @tf.custom_gradient
+    def _fn(x):
+        y = _engine_call(
+            lambda v: _eager.reducescatter(v, name=nm, op=rop),
+            x, x.dtype)
+        y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
+
+        def grad(dy):
+            g = _engine_call(
+                lambda v: _eager.allgather(v, name=f"{nm}.grad"),
+                dy, dy.dtype)
+            g.set_shape(x.shape)
+            if rop == ReduceOp.AVERAGE:
+                g = g / size()
+            return g
+
+        return y, grad
+
+    return _fn(x)
+
+
 def broadcast(tensor, root_rank=0, name=None):
     """Differentiable broadcast from root; backward sums to root."""
     nm = _auto_name("tf.broadcast", name)

@@ -48,6 +48,19 @@ def scenario_ops():
     # broadcast_object
     obj = hvd.broadcast_object({"a": rank} if rank == 0 else None, 0)
     assert obj == {"a": 0}
+    # reducescatter: sum across ranks, rank r keeps row chunk r;
+    # differentiable (backward = allgather of the chunk gradients)
+    x = tf.Variable(tf.ones([size * 2, 3]) * float(rank + 1))
+    with tf.GradientTape() as tape:
+        out = hvd.reducescatter(x, op=hvd.Sum, name="tf.rs")
+        loss = tf.reduce_sum(out * float(rank + 1))
+    np.testing.assert_allclose(
+        out.numpy(), np.full((2, 3), sum(r + 1.0 for r in range(size))))
+    g = tape.gradient(loss, x)
+    # d loss / d x = allgather of each rank's chunk weight (rank+1)
+    expect_g = np.concatenate(
+        [np.full((2, 3), r + 1.0, np.float32) for r in range(size)])
+    np.testing.assert_allclose(g.numpy(), expect_g)
 
 
 def scenario_graph_mode():
