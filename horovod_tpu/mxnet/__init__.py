@@ -83,6 +83,15 @@ def broadcast(tensor, root_rank=0, name=None):
                                   name=name), tensor)
 
 
+def reducescatter(tensor, average=None, name=None, op=None):
+    """Reduce across ranks, scatter over dim 0 (the reference project
+    added ``hvd.reducescatter`` right after the v0.19 line)."""
+    from horovod_tpu.ops import eager
+
+    return _to_nd(eager.reducescatter(_from_nd(tensor), average=average,
+                                      name=name, op=op), tensor)
+
+
 def DistributedOptimizer(optimizer, op=None):
     """Parity: mxnet/__init__.py:40-69 — wraps an mxnet optimizer,
     allreducing gradients with rescale_grad divided by world size."""

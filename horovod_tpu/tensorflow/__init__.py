@@ -151,6 +151,12 @@ def reducescatter(tensor, average=None, name=None, op=None):
     nm = _auto_name("tf.reducescatter", name)
     x = tf.convert_to_tensor(tensor)
     rop = _resolve_op(op, average)
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        # The registered gradient (allgather) is the Sum/Average
+        # adjoint; Min/Max/Product would need a subgradient and are not
+        # in the reference's TF surface either.
+        raise ValueError(
+            f"tf reducescatter supports Sum/Average, got {rop}")
 
     @tf.custom_gradient
     def _fn(x):

@@ -84,7 +84,8 @@ _SLOW_NODEIDS = (
 # re-runs of the same scenario; keep `mixed` coverage on test_allreduce
 # and test_hierarchical_vs_flat, prune the rest by default.
 _ENGINE_MATRIX_KEEP = ("test_allreduce", "test_hierarchical_vs_flat",
-                       "test_reducescatter")
+                       "test_reducescatter",
+                       "test_random_ops_differential")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -247,6 +247,105 @@ def scenario_hier_vs_flat():
     np.testing.assert_array_equal(out, expect)
 
 
+def scenario_random_ops():
+    """Randomized differential test: every rank derives the SAME random
+    op sequence from HVD_FUZZ_SEED and checks each result against a
+    numpy oracle computed from the (deterministic) per-rank inputs.
+
+    Ops draw from a fixed pool of named slots, so names RECUR with the
+    same (op, dtype, shape) but fresh values — re-submissions ride the
+    response-cache hit path (steady-state allreduce traffic), while
+    fresh slots negotiate.  Interleaved async handles exercise fusion
+    windows and op ordering; per-slot value salts catch stale-result
+    bugs a cache could introduce."""
+    rank, size = hvd.rank(), hvd.size()
+    seed = int(os.environ.get("HVD_FUZZ_SEED", "0"))
+    seq = np.random.RandomState(seed)  # identical stream on every rank
+
+    def rank_input(salt, shape, dtype, r):
+        return (np.arange(int(np.prod(shape)), dtype=np.float64)
+                .reshape(shape) * (r + 1) + salt).astype(dtype)
+
+    n_slots = 12
+    slots = []
+    for _ in range(n_slots):
+        kind = str(seq.choice(["allreduce", "allgather", "broadcast",
+                               "reducescatter", "grouped"]))
+        dtype = seq.choice([np.float32, np.float64, np.int32])
+        shape = tuple(int(d) for d in
+                      seq.randint(1, 5, size=seq.randint(1, 3)))
+        aux = int(seq.randint(0, size))  # broadcast root / d0 remainder
+        slots.append((kind, dtype, shape, aux))
+
+    outstanding = {}  # slot -> (handle, oracle, name)
+
+    def settle(s):
+        h, oracle, nm = outstanding.pop(s)
+        np.testing.assert_allclose(
+            np.asarray(hvd.synchronize(h), dtype=np.float64),
+            np.asarray(oracle, dtype=np.float64), rtol=1e-6, err_msg=nm)
+
+    n_ops = int(os.environ.get("HVD_FUZZ_OPS", "40"))
+    for i in range(n_ops):
+        s = int(seq.randint(0, n_slots))
+        if s in outstanding:
+            settle(s)  # frees the name; the re-submission below is the
+            # cache-hit path for allreduce slots
+        kind, dtype, shape, aux = slots[s]
+        name = f"fuzz.{s}"
+        if kind == "allreduce":
+            x = rank_input(i, shape, dtype, rank)
+            oracle = sum(rank_input(i, shape, np.float64, r)
+                         for r in range(size)).astype(dtype)
+            outstanding[s] = (hvd.allreduce_async(x, op=hvd.Sum,
+                                                  name=name), oracle, name)
+        elif kind == "allgather":
+            # ragged: rank r contributes r+1 leading rows
+            xr = rank_input(i, (rank + 1,) + shape, dtype, rank)
+            oracle = np.concatenate(
+                [rank_input(i, (r + 1,) + shape, np.float64, r)
+                 for r in range(size)]).astype(dtype)
+            outstanding[s] = (hvd.allgather_async(xr, name=name), oracle,
+                              name)
+        elif kind == "broadcast":
+            x = rank_input(i, shape, dtype, rank)
+            oracle = rank_input(i, shape, dtype, aux)
+            outstanding[s] = (hvd.broadcast_async(x, root_rank=aux,
+                                                  name=name), oracle, name)
+        elif kind == "reducescatter":
+            d0 = 2 * size + aux
+            xr = rank_input(i, (d0,) + shape, dtype, rank)
+            full = sum(rank_input(i, (d0,) + shape, np.float64, r)
+                       for r in range(size)).astype(dtype)
+            base, rem = divmod(d0, size)
+            lo = rank * base + min(rank, rem)
+            hi = lo + base + (1 if rank < rem else 0)
+            outstanding[s] = (hvd.reducescatter_async(xr, op=hvd.Sum,
+                                                      name=name),
+                              full[lo:hi], name)
+        else:  # grouped allreduce: a synchronous burst (fusion window)
+            xs = [rank_input(i * 10 + j, shape, np.float32, rank)
+                  for j in range(3)]
+            oracles = [sum(rank_input(i * 10 + j, shape, np.float64, r)
+                           for r in range(size)).astype(np.float32)
+                       for j in range(3)]
+            outs = hvd.grouped_allreduce(xs, op=hvd.Sum, name=name)
+            for out, oracle in zip(outs, oracles):
+                np.testing.assert_allclose(out, oracle, rtol=1e-6,
+                                           err_msg=name)
+            continue
+        # Randomly settle immediately vs leave in flight to interleave.
+        if seq.rand() < 0.5:
+            settle(s)
+    for s in list(outstanding):
+        settle(s)
+    stats = hvd.cache_stats() if hasattr(hvd, "cache_stats") else None
+    if stats is not None and size > 1:
+        assert stats["hits"] > 0, (
+            f"fuzz never hit the response cache (stats: {stats}); slot "
+            "reuse is supposed to drive the steady-state hit path")
+
+
 def scenario_join():
     rank, size = hvd.rank(), hvd.size()
     # rank r has r+1 batches; ranks keep allreducing until out of data.

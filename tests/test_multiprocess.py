@@ -238,6 +238,18 @@ def test_hierarchical_fusion(engine):
     assert_gang("fusion", 4, engine, profile="hier")
 
 
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_random_ops_differential(seed, engine):
+    """Randomized differential fuzz: interleaved async collectives
+    (recurring names riding the response-cache hit path) with a numpy
+    oracle, across engines — in conftest's _ENGINE_MATRIX_KEEP so the
+    mixed wire-compat runs stay in the default matrix."""
+    run_workers("random_ops", 3, engine=engine,
+                extra_env={"HVD_FUZZ_SEED": str(seed),
+                           "HVD_FUZZ_OPS": "40"})
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_join(engine):
     run_workers("join", 3, engine=engine)

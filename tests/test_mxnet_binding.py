@@ -113,6 +113,10 @@ def test_mpi_ops_surface(hvd_mx):
     # Plain numpy works without mxnet types at all.
     np.testing.assert_allclose(
         hvd_mx.allreduce(np.float32(4.0), name="mx.scalar"), [4.0])
+    np.testing.assert_allclose(
+        np.asarray(hvd_mx.reducescatter(_FakeND([1.0, 2.0]), name="mx.rs",
+                                        op=None)),
+        [1.0, 2.0])
 
 
 def test_gate_without_mxnet():
