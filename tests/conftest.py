@@ -59,6 +59,7 @@ _SLOW_NODEIDS = (
     "test_examples.py::test_jax_mnist_2proc",
     "test_examples.py::test_pytorch_imagenet_resnet50_2proc",
     "test_examples.py::test_scaling_benchmark_virtual_mesh",
+    "test_examples.py::test_jax_transformer_lm_3axis",
     "test_tf_keras_binding.py::test_tf_ops",
     "test_tf_keras_binding.py::test_tf_graph_mode",
     "test_tf_keras_binding.py::test_tf_tape",
@@ -78,6 +79,10 @@ _SLOW_NODEIDS = (
     "test_models.py::test_resnet_dp_train_step",
     "test_models.py::test_mnist_train_decreases_loss",
     "test_spark.py::test_keras_estimator_fit",
+    # fuzz: default keeps seed 0 across all engines + seed 7 native;
+    # the remaining seed-7 wire-compat re-runs ride the full matrix
+    "test_multiprocess.py::test_random_ops_differential[7-py]",
+    "test_multiprocess.py::test_random_ops_differential[7-mixed]",
 )
 
 # Multiprocess matrix: non-native engine variants are wire-compatibility

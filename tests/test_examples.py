@@ -42,6 +42,29 @@ def test_jax_mnist_2proc():
     assert "images/sec" in out
 
 
+def test_jax_transformer_lm_mesh(tmp_path):
+    """Flagship in-graph workflow: multi-axis mesh + checkpoint resume.
+    (conftest already forces the 8-virtual-device XLA flags into
+    os.environ, which run_example's child inherits.)"""
+    ckpt = str(tmp_path / "ck")
+    base = ["--dp", "2", "--checkpoint-dir", ckpt,
+            "--checkpoint-every", "10", "--fp32"]
+    out = run_example("jax_transformer_lm.py", 1,
+                      ["--steps", "12", *base], timeout=300)
+    assert "tokens/sec" in out
+    out = run_example("jax_transformer_lm.py", 1,
+                      ["--steps", "16", *base], timeout=300)
+    assert "resumed from step 12" in out
+
+
+def test_jax_transformer_lm_3axis():
+    out = run_example(
+        "jax_transformer_lm.py", 1,
+        ["--dp", "2", "--tp", "2", "--sp", "2", "--steps", "10",
+         "--fp32"], timeout=420)
+    assert "mesh={'dp': 2, 'tp': 2, 'sp': 2}" in out
+
+
 def test_jax_word2vec_2proc():
     out = run_example("jax_word2vec.py", 2,
                       ["--steps", "60", "--corpus-len", "5000",
