@@ -112,6 +112,7 @@ def main():
                 jnp.asarray(toks[:, 1:], jnp.int32))
 
     t0 = time.time()
+    last_saved = start_step
     for i in range(start_step, args.steps):
         tokens, targets = batch(i)
         state, loss = step(state, tokens, targets)
@@ -119,6 +120,7 @@ def main():
             print(f"step {i + 1}: loss {float(loss):.4f}")
         if ckpt_path and (i + 1) % args.checkpoint_every == 0:
             ckpt.save(ckpt_path, state)
+            last_saved = i + 1
     dt = time.time() - t0
     done = args.steps - start_step
     if done > 0:
@@ -126,7 +128,8 @@ def main():
         print(f"done: mesh={axes or {'dp': 1}} ({n_mesh} devices), "
               f"{toks / dt:.0f} tokens/sec")
     if ckpt_path:
-        ckpt.save(ckpt_path, state)
+        if last_saved != args.steps:
+            ckpt.save(ckpt_path, state)
         print(f"checkpoint at step {int(jax.device_get(state.step))}")
 
 

@@ -251,6 +251,17 @@ def test_random_ops_differential(seed, engine):
 
 
 @pytest.mark.parametrize("engine", ENGINES)
+def test_random_ops_differential_hierarchical(engine):
+    """The same fuzz stream over the two-level data plane (np=4 as
+    2 nodes x 2 local ranks, both hierarchical flags on) — the oracle
+    doesn't care which data plane ran, so any divergence is a
+    hierarchy bug."""
+    run_workers("random_ops", 4, engine=engine, local_size=2,
+                extra_env={"HVD_FUZZ_SEED": "11", "HVD_FUZZ_OPS": "30",
+                           **_HIER_ENV})
+
+
+@pytest.mark.parametrize("engine", ENGINES)
 def test_join(engine):
     run_workers("join", 3, engine=engine)
 
