@@ -107,9 +107,19 @@ int hvd_is_aborted() { return g_engine && g_engine->aborted() ? 1 : 0; }
 
 const char* hvd_last_error() { return g_last_error.c_str(); }
 
+int64_t hvd_register_process_set(int id, const int32_t* ranks, int n) {
+  if (!g_engine) {
+    g_last_error = "engine not initialized";
+    return -1;
+  }
+  g_engine->RegisterProcessSet(id, std::vector<int>(ranks, ranks + n));
+  return 0;
+}
+
 int64_t hvd_allreduce_async(const char* name, void* buf, int ndim,
                             const int64_t* dims, int dtype, int op,
-                            double prescale, double postscale) {
+                            double prescale, double postscale, int ps_id,
+                            int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
@@ -117,13 +127,15 @@ int64_t hvd_allreduce_async(const char* name, void* buf, int ndim,
   std::string err;
   int64_t h = g_engine->EnqueueAllreduce(
       name, buf, MakeShape(ndim, dims), static_cast<hvd::DataType>(dtype),
-      static_cast<hvd::ReduceOp>(op), prescale, postscale, &err);
+      static_cast<hvd::ReduceOp>(op), prescale, postscale, &err, ps_id,
+      ps_size);
   if (h < 0) g_last_error = err;
   return h;
 }
 
 int64_t hvd_allgather_async(const char* name, const void* buf, int ndim,
-                            const int64_t* dims, int dtype) {
+                            const int64_t* dims, int dtype, int ps_id,
+                            int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
@@ -131,7 +143,7 @@ int64_t hvd_allgather_async(const char* name, const void* buf, int ndim,
   std::string err;
   int64_t h = g_engine->EnqueueAllgather(name, buf, MakeShape(ndim, dims),
                                          static_cast<hvd::DataType>(dtype),
-                                         &err);
+                                         &err, ps_id, ps_size);
   if (h < 0) g_last_error = err;
   return h;
 }
@@ -152,7 +164,8 @@ void hvd_f32_to_fp8(int kind, const float* in, uint8_t* out, int n) {
 }
 
 int64_t hvd_reducescatter_async(const char* name, const void* buf, int ndim,
-                                const int64_t* dims, int dtype, int op) {
+                                const int64_t* dims, int dtype, int op,
+                                int ps_id, int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
@@ -160,13 +173,14 @@ int64_t hvd_reducescatter_async(const char* name, const void* buf, int ndim,
   std::string err;
   int64_t h = g_engine->EnqueueReduceScatter(
       name, buf, MakeShape(ndim, dims), static_cast<hvd::DataType>(dtype),
-      static_cast<hvd::ReduceOp>(op), &err);
+      static_cast<hvd::ReduceOp>(op), &err, ps_id, ps_size);
   if (h < 0) g_last_error = err;
   return h;
 }
 
 int64_t hvd_broadcast_async(const char* name, void* buf, int ndim,
-                            const int64_t* dims, int dtype, int root_rank) {
+                            const int64_t* dims, int dtype, int root_rank,
+                            int ps_id, int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
@@ -174,7 +188,7 @@ int64_t hvd_broadcast_async(const char* name, void* buf, int ndim,
   std::string err;
   int64_t h = g_engine->EnqueueBroadcast(name, buf, MakeShape(ndim, dims),
                                          static_cast<hvd::DataType>(dtype),
-                                         root_rank, &err);
+                                         root_rank, &err, ps_id, ps_size);
   if (h < 0) g_last_error = err;
   return h;
 }

@@ -131,7 +131,11 @@ bool ResponseCache::SameParams(const Request& a, const Request& b) {
 ResponseCache::State ResponseCache::Classify(const Request& req,
                                              uint32_t* position) {
   *position = 0;
-  if (!enabled() || req.request_type != RequestType::ALLREDUCE) return MISS;
+  // Process-set ops bypass the cache (positions must stay coherent on
+  // EVERY rank; non-members never see the set's traffic).
+  if (!enabled() || req.request_type != RequestType::ALLREDUCE ||
+      req.process_set_id)
+    return MISS;
   auto it = by_name_.find(req.tensor_name);
   if (it == by_name_.end()) {
     ++misses;
@@ -178,7 +182,7 @@ int64_t ResponseCache::PositionOf(const std::string& name) const {
 
 void ResponseCache::Put(const Response& resp) {
   if (!enabled() || resp.response_type != ResponseType::ALLREDUCE ||
-      !resp.error_message.empty())
+      !resp.error_message.empty() || resp.process_set_id)
     return;
   bool have_shapes = resp.tensor_shapes.size() == resp.tensor_names.size();
   for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
@@ -337,7 +341,8 @@ int64_t Engine::Enqueue(TensorTableEntry entry, std::string* err) {
 int64_t Engine::EnqueueAllreduce(const std::string& name, void* buf,
                                  const TensorShape& shape, DataType dt,
                                  ReduceOp op, double prescale,
-                                 double postscale, std::string* err) {
+                                 double postscale, std::string* err,
+                                 int32_t ps_id, int32_t ps_size) {
   TensorTableEntry e;
   e.name = name;
   e.data = static_cast<uint8_t*>(buf);
@@ -351,12 +356,15 @@ int64_t Engine::EnqueueAllreduce(const std::string& name, void* buf,
   e.request.reduce_op = op;
   e.request.prescale_factor = prescale;
   e.request.postscale_factor = postscale;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   return Enqueue(std::move(e), err);
 }
 
 int64_t Engine::EnqueueAllgather(const std::string& name, const void* buf,
                                  const TensorShape& shape, DataType dt,
-                                 std::string* err) {
+                                 std::string* err, int32_t ps_id,
+                                 int32_t ps_size) {
   TensorTableEntry e;
   e.name = name;
   e.data = static_cast<uint8_t*>(const_cast<void*>(buf));
@@ -367,12 +375,15 @@ int64_t Engine::EnqueueAllgather(const std::string& name, const void* buf,
   e.request.tensor_type = dt;
   e.request.tensor_name = name;
   e.request.tensor_shape = shape;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   return Enqueue(std::move(e), err);
 }
 
 int64_t Engine::EnqueueBroadcast(const std::string& name, void* buf,
                                  const TensorShape& shape, DataType dt,
-                                 int root_rank, std::string* err) {
+                                 int root_rank, std::string* err,
+                                 int32_t ps_id, int32_t ps_size) {
   if (root_rank < 0 || root_rank >= cfg_.size) {
     *err = "broadcast root rank " + std::to_string(root_rank) +
            " out of range [0, " + std::to_string(cfg_.size) + ")";
@@ -389,6 +400,8 @@ int64_t Engine::EnqueueBroadcast(const std::string& name, void* buf,
   e.request.tensor_name = name;
   e.request.tensor_shape = shape;
   e.request.root_rank = root_rank;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   return Enqueue(std::move(e), err);
 }
 
@@ -422,10 +435,36 @@ int64_t Engine::EnqueueAlltoall(const std::string& name, const void* buf,
   return Enqueue(std::move(e), err);
 }
 
+void Engine::RegisterProcessSet(int32_t id, std::vector<int> ranks) {
+  std::lock_guard<std::mutex> lk(process_sets_mu_);
+  process_sets_[id] = std::move(ranks);
+}
+
+std::vector<int> Engine::ProcessSetRanks(int32_t id) {
+  std::lock_guard<std::mutex> lk(process_sets_mu_);
+  auto it = process_sets_.find(id);
+  return it != process_sets_.end() ? it->second : std::vector<int>{};
+}
+
+std::pair<std::vector<int>, int> Engine::ResponseGroup(
+    const Response& resp) {
+  std::vector<int> group;
+  int me = cfg_.rank;
+  if (resp.process_set_id) {
+    group = ProcessSetRanks(resp.process_set_id);
+    me = static_cast<int>(
+        std::find(group.begin(), group.end(), cfg_.rank) - group.begin());
+  } else {
+    for (int r = 0; r < cfg_.size; ++r) group.push_back(r);
+  }
+  return {std::move(group), me};
+}
+
 int64_t Engine::EnqueueReduceScatter(const std::string& name,
                                      const void* buf,
                                      const TensorShape& shape, DataType dt,
-                                     ReduceOp op, std::string* err) {
+                                     ReduceOp op, std::string* err,
+                                     int32_t ps_id, int32_t ps_size) {
   if (shape.dims.empty()) {
     *err = "reducescatter needs at least one dimension to scatter over "
            "(got a scalar)";
@@ -442,6 +481,8 @@ int64_t Engine::EnqueueReduceScatter(const std::string& name,
   e.request.tensor_name = name;
   e.request.tensor_shape = shape;
   e.request.reduce_op = op;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   return Enqueue(std::move(e), err);
 }
 
@@ -662,10 +703,13 @@ void Engine::AbsorbRequest(const Request& req,
   if (req.request_type == RequestType::JOIN) {
     joined_ranks_.insert(req.request_rank);
     last_joined_rank_.store(req.request_rank);
-    // Tensors waiting only on joined ranks become ready.
+    // Tensors waiting only on joined ranks become ready (global-set
+    // entries only; join never applies to process-set traffic).
     for (auto& kv : msg_table_) {
-      if (static_cast<int>(kv.second.requests.size()) ==
-          cfg_.size - static_cast<int>(joined_ranks_.size())) {
+      if (!kv.second.requests.empty() &&
+          kv.second.requests[0].process_set_id == 0 &&
+          static_cast<int>(kv.second.requests.size()) ==
+              cfg_.size - static_cast<int>(joined_ranks_.size())) {
         if (std::find(ready->begin(), ready->end(), kv.first) == ready->end())
           ready->push_back(kv.first);
       }
@@ -677,12 +721,22 @@ void Engine::AbsorbRequest(const Request& req,
       timeline_.NegotiateStart(req.tensor_name, OpName(req.request_type));
     timeline_.NegotiateRankReady(req.tensor_name, req.request_rank);
   }
-  auto& ent = msg_table_[req.tensor_name];
+  // Table key: process-set requests are scoped by set id, so the same
+  // tensor name may be in flight in two different sets at once.
+  std::string key =
+      req.process_set_id
+          ? req.tensor_name + "@ps" + std::to_string(req.process_set_id)
+          : req.tensor_name;
+  auto& ent = msg_table_[key];
   if (ent.requests.empty()) ent.first_seen_s = NowS();
   ent.requests.push_back(req);
-  if (static_cast<int>(ent.requests.size()) ==
-      cfg_.size - static_cast<int>(joined_ranks_.size()))
-    ready->push_back(req.tensor_name);
+  // Process-set request: ready when every member is in (join is
+  // global-set-only); global: all non-joined ranks.
+  int full_at = req.process_set_id
+                    ? req.process_set_size
+                    : cfg_.size - static_cast<int>(joined_ranks_.size());
+  if (static_cast<int>(ent.requests.size()) == full_at)
+    ready->push_back(key);
 }
 
 bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
@@ -733,14 +787,18 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
 
   std::vector<Response> responses;
   std::vector<uint32_t> hit_positions;
-  for (auto& name : ready) {
-    auto it = msg_table_.find(name);
+  for (auto& key : ready) {
+    auto it = msg_table_.find(key);
     if (it == msg_table_.end()) continue;
     auto reqs = std::move(it->second.requests);
     msg_table_.erase(it);
+    const std::string& name = reqs[0].tensor_name;  // key may be scoped
     timeline_.NegotiateEnd(name);
+    // Hits are global-set-only (key == name there); looking up by key
+    // keeps a set-scoped completion from stealing a same-named global
+    // tensor's hit record.
     std::set<int> hit_ranks;
-    auto hit = hit_ranks_.find(name);
+    auto hit = hit_ranks_.find(key);
     if (hit != hit_ranks_.end()) {
       hit_ranks = std::move(hit->second);
       hit_ranks_.erase(hit);
@@ -884,6 +942,21 @@ Response Engine::ConstructResponse(const std::string& name,
       })) {
     err = "Mismatched collective operations for tensor " + name;
   } else if (mismatch([&](const Request& r) {
+               return r.process_set_id != first.process_set_id ||
+                      r.process_set_size != first.process_set_size;
+             })) {
+    err = "Mismatched process sets for tensor " + name;
+  } else if (first.process_set_id &&
+             (first.request_type == RequestType::ALLTOALL ||
+              first.request_type == RequestType::JOIN ||
+              first.request_type == RequestType::BARRIER)) {
+    err = std::string(OpName(first.request_type)) +
+          " does not support process sets (tensor " + name + ")";
+  } else if (first.process_set_id &&
+             first.request_type == RequestType::ALLREDUCE &&
+             first.reduce_op == ReduceOp::ADASUM) {
+    err = "Adasum is not supported with process sets (tensor " + name + ")";
+  } else if (mismatch([&](const Request& r) {
                return r.tensor_type != first.tensor_type;
              })) {
     err = "Mismatched data types for tensor " + name + ": ";
@@ -914,6 +987,18 @@ Response Engine::ConstructResponse(const std::string& name,
                  return r.tensor_shape != first.tensor_shape;
                })) {
       err = "Mismatched broadcast tensor shapes for " + name;
+    } else if (first.process_set_id) {
+      auto members = ProcessSetRanks(first.process_set_id);
+      if (!members.empty() &&
+          std::find(members.begin(), members.end(), first.root_rank) ==
+              members.end()) {
+        // Authoritative check (wrappers pre-check too): a non-member
+        // root would skip while members block in RecvFrame.
+        err = "broadcast root rank " + std::to_string(first.root_rank) +
+              " is not a member of process set " +
+              std::to_string(first.process_set_id) + " (tensor " + name +
+              ")";
+      }
     }
   } else if (first.request_type == RequestType::ALLGATHER) {
     for (auto& r : reqs) {
@@ -953,6 +1038,7 @@ Response Engine::ConstructResponse(const std::string& name,
   resp.tensor_names = {name};
   resp.tensor_type = first.tensor_type;
   resp.devices = {first.device};
+  resp.process_set_id = first.process_set_id;
   if (first.request_type == RequestType::ALLREDUCE) {
     resp.tensor_sizes = {first.tensor_shape.num_elements()};
     resp.reduce_op = first.reduce_op;
@@ -962,10 +1048,28 @@ Response Engine::ConstructResponse(const std::string& name,
     // coherent on every rank (incl. joined ranks' stand-ins).
     resp.tensor_shapes = {first.tensor_shape};
   } else if (first.request_type == RequestType::ALLGATHER) {
-    // First-dim size per rank, rank order (0 for joined ranks).
+    // First-dim size per rank, rank order (0 for joined ranks); for a
+    // process set, per member in member order.
     std::map<int, const Request*> by_rank;
     for (auto& r : reqs) by_rank[r.request_rank] = &r;
-    for (int r = 0; r < cfg_.size; ++r) {
+    std::vector<int> order;
+    if (first.process_set_id) {
+      auto members = ProcessSetRanks(first.process_set_id);
+      if (members.empty()) {
+        Response er;
+        er.response_type = ResponseType::ERROR;
+        er.tensor_names = {name};
+        er.error_message =
+            "process set " + std::to_string(first.process_set_id) +
+            " is not registered on the coordinator (construct the "
+            "ProcessSet on every rank)";
+        return er;
+      }
+      order = members;
+    } else {
+      for (int r = 0; r < cfg_.size; ++r) order.push_back(r);
+    }
+    for (int r : order) {
       auto it = by_rank.find(r);
       resp.tensor_sizes.push_back(
           it != by_rank.end() ? it->second->tensor_shape.dims[0] : 0);
@@ -1003,6 +1107,7 @@ std::vector<Response> Engine::FuseResponses(std::vector<Response> responses) {
         pending.devices == r.devices && pending.reduce_op == r.reduce_op &&
         pending.prescale_factor == r.prescale_factor &&
         pending.postscale_factor == r.postscale_factor &&
+        pending.process_set_id == r.process_set_id &&
         pending_bytes + nbytes <= cfg_.fusion_threshold) {
       pending.tensor_names.insert(pending.tensor_names.end(),
                                   r.tensor_names.begin(),
@@ -1109,6 +1214,16 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
     return;
   }
 
+  if (resp.process_set_id && resp.response_type != ResponseType::ERROR) {
+    // Process-set responses reach every rank in the response stream;
+    // non-members simply skip (members always have the entries — join
+    // is global-set-only, so no stand-ins here).
+    auto members = ProcessSetRanks(resp.process_set_id);
+    if (std::find(members.begin(), members.end(), cfg_.rank) ==
+        members.end())
+      return;
+  }
+
   if (!from_cache && resp.response_type == ResponseType::ALLREDUCE) {
     // Populate the response cache BEFORE execution and regardless of
     // execution outcome: the put stores metadata only, and doing it
@@ -1199,15 +1314,23 @@ void Engine::DoAllreduce(std::vector<TensorTableEntry>& entries,
 
   if (prescale != 1.0) ScaleInPlace(flat, total, dt, prescale);
 
+  // Group = the full world, or the process set's members (subgroup
+  // rings reuse the full mesh sockets; Adasum/hierarchical are
+  // rejected for sets at negotiation).
+  auto [group, me] = ResponseGroup(resp);
+
   if (op == ReduceOp::ADASUM) {
     AdasumFlat(flat, total, dt);
-  } else if (cfg_.hierarchical_allreduce && HierarchicalTopologyOk()) {
+  } else if (!resp.process_set_id && cfg_.hierarchical_allreduce &&
+             HierarchicalTopologyOk()) {
     HierarchicalAllreduceFlat(flat, total, dt, op);
   } else {
-    RingAllreduceFlat(flat, total, dt, op);
+    RingAllreduceGroup(flat, total, dt, op, group, me);
   }
 
-  if (op == ReduceOp::AVERAGE) AverageInPlace(flat, total, dt, cfg_.size);
+  if (op == ReduceOp::AVERAGE)
+    AverageInPlace(flat, total, dt,
+                   static_cast<int64_t>(group.size()));
   if (postscale != 1.0) ScaleInPlace(flat, total, dt, postscale);
 
   if (fused) {
@@ -1361,13 +1484,16 @@ void Engine::AdasumFlat(uint8_t* buf, int64_t nelems, DataType dt) {
 
 void Engine::DoAllgather(std::vector<TensorTableEntry>& entries,
                          const Response& resp) {
-  if (cfg_.hierarchical_allgather && HierarchicalTopologyOk()) {
+  if (!resp.process_set_id && cfg_.hierarchical_allgather &&
+      HierarchicalTopologyOk()) {
     DoAllgatherHierarchical(entries, resp);
     return;
   }
   // Ragged ring allgatherv (parity: cpu_backend.allgather; displacement
-  // logic parity: MPIAllgather, mpi_operations.cc:83-166).
-  int size = cfg_.size, rank = cfg_.rank;
+  // logic parity: MPIAllgather, mpi_operations.cc:83-166).  For a
+  // process set the ring walks the member list.
+  auto [group, me] = ResponseGroup(resp);
+  int size = static_cast<int>(group.size()), rank = me;
   for (auto& e : entries) {
     size_t isz = ItemSize(resp.tensor_type);
     struct Block {
@@ -1379,8 +1505,8 @@ void Engine::DoAllgather(std::vector<TensorTableEntry>& entries,
     blocks[rank].ptr = e.data;
     blocks[rank].len = e.nelems * isz;
     if (size > 1) {
-      int right = data_fds_[Mod(rank + 1, size)];
-      int left = data_fds_[Mod(rank - 1, size)];
+      int right = data_fds_[group[Mod(rank + 1, size)]];
+      int left = data_fds_[group[Mod(rank - 1, size)]];
       for (int step = 0; step < size - 1; ++step) {
         int64_t send_idx = Mod(rank - step, size);
         int64_t recv_idx = Mod(rank - step - 1, size);
@@ -1495,7 +1621,12 @@ void Engine::DoAllgatherHierarchical(std::vector<TensorTableEntry>& entries,
 
 void Engine::DoBroadcast(std::vector<TensorTableEntry>& entries,
                          const Response& resp) {
-  int size = cfg_.size, rank = cfg_.rank;
+  int rank = cfg_.rank;
+  // root is a GLOBAL rank; for a process set the fan-out covers the
+  // member list only.
+  auto [group, me_unused] = ResponseGroup(resp);
+  (void)me_unused;
+  int size = static_cast<int>(group.size());
   for (auto& e : entries) {
     int root = resp.tensor_sizes.empty()
                    ? e.request.root_rank
@@ -1504,7 +1635,7 @@ void Engine::DoBroadcast(std::vector<TensorTableEntry>& entries,
     if (size > 1) {
       if (rank == root) {
         std::vector<int> others;
-        for (int r = 0; r < size; ++r)
+        for (int r : group)
           if (r != root) others.push_back(data_fds_[r]);
         MultiSend(others, e.data, nbytes);
       } else {
@@ -1580,7 +1711,8 @@ void Engine::DoReduceScatter(std::vector<TensorTableEntry>& entries,
   // stay bit-compatible).  The standard walk leaves rank r owning chunk
   // (r+1)%size; shifting the start by one virtual rank leaves it owning
   // chunk r, which is the API contract.
-  int size = cfg_.size, rank = cfg_.rank;
+  auto [group, me] = ResponseGroup(resp);
+  int size = static_cast<int>(group.size()), rank = me;
   DataType dt = resp.tensor_type;
   size_t isz = ItemSize(dt);
   ReduceOp op = resp.reduce_op;
@@ -1604,8 +1736,8 @@ void Engine::DoReduceScatter(std::vector<TensorTableEntry>& entries,
       int64_t hi = row_bounds[i + 1] * row_elems;
       chunks[i].assign(e.data + lo * isz, e.data + hi * isz);
     }
-    int right = data_fds_[Mod(rank + 1, size)];
-    int left = data_fds_[Mod(rank - 1, size)];
+    int right = data_fds_[group[Mod(rank + 1, size)]];
+    int left = data_fds_[group[Mod(rank - 1, size)]];
     std::vector<uint8_t> tmp;
     for (int step = 0; step < size - 1; ++step) {
       int64_t send_idx = Mod(rank - 1 - step, size);
@@ -1621,7 +1753,7 @@ void Engine::DoReduceScatter(std::vector<TensorTableEntry>& entries,
     if (op == ReduceOp::AVERAGE)
       AverageInPlace(result.data(),
                      static_cast<int64_t>(result.size() / isz), dt,
-                     cfg_.size);
+                     static_cast<int64_t>(size));
     ReleaseName(e.name);
     if (e.handle >= 0)
       handles_.MarkDone(e.handle, Status::OK(), std::move(result));

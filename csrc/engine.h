@@ -174,23 +174,36 @@ class Engine {
   int64_t EnqueueAllreduce(const std::string& name, void* buf,
                            const TensorShape& shape, DataType dt, ReduceOp op,
                            double prescale, double postscale,
-                           std::string* err);
+                           std::string* err, int32_t ps_id = 0,
+                           int32_t ps_size = 0);
   int64_t EnqueueAllgather(const std::string& name, const void* buf,
                            const TensorShape& shape, DataType dt,
-                           std::string* err);
+                           std::string* err, int32_t ps_id = 0,
+                           int32_t ps_size = 0);
   int64_t EnqueueBroadcast(const std::string& name, void* buf,
                            const TensorShape& shape, DataType dt,
-                           int root_rank, std::string* err);
+                           int root_rank, std::string* err,
+                           int32_t ps_id = 0, int32_t ps_size = 0);
   int64_t EnqueueAlltoall(const std::string& name, const void* buf,
                           const TensorShape& shape, DataType dt,
                           const std::vector<int64_t>& splits,
                           std::string* err);
   int64_t EnqueueReduceScatter(const std::string& name, const void* buf,
                                const TensorShape& shape, DataType dt,
-                               ReduceOp op, std::string* err);
+                               ReduceOp op, std::string* err,
+                               int32_t ps_id = 0, int32_t ps_size = 0);
 
   int Barrier(std::string* err);  // blocking; 0 ok
   int Join();                     // blocking; returns last joined rank
+
+  // Process sets: register member ranks for a set id (idempotent; the
+  // id is the Python-side hash of the sorted members).  Enqueue fns
+  // take (ps_id, ps_size); 0/0 = the global set.
+  void RegisterProcessSet(int32_t id, std::vector<int> ranks);
+  std::vector<int> ProcessSetRanks(int32_t id);
+  // (member global ranks, my index) for a response — the full world for
+  // the global set (mirrors cpu_backend.resp_group).
+  std::pair<std::vector<int>, int> ResponseGroup(const Response& resp);
 
   // hits/misses/evictions/size/capacity, for introspection + tests.
   void CacheStats(int64_t out[5]);
@@ -304,6 +317,8 @@ class Engine {
   std::atomic<bool> shutdown_{false};
   std::atomic<bool> aborted_{false};
   std::atomic<int64_t> barrier_counter_{0};
+  std::mutex process_sets_mu_;
+  std::map<int32_t, std::vector<int>> process_sets_;
   std::thread bg_;
 };
 

@@ -92,6 +92,8 @@ void EncodeRequest(const Request& r, std::vector<uint8_t>& b) {
   PutF64(b, r.postscale_factor);
   PutU8(b, static_cast<uint8_t>(r.tensor_shape.dims.size()));
   for (auto d : r.tensor_shape.dims) PutI64(b, d);
+  PutI32(b, r.process_set_id);
+  PutI32(b, r.process_set_size);
 }
 
 Request DecodeRequest(Reader& rd) {
@@ -107,6 +109,8 @@ Request DecodeRequest(Reader& rd) {
   r.postscale_factor = rd.F64();
   uint8_t ndim = rd.U8();
   for (uint8_t i = 0; i < ndim; ++i) r.tensor_shape.dims.push_back(rd.I64());
+  r.process_set_id = rd.I32();
+  r.process_set_size = rd.I32();
   return r;
 }
 
@@ -128,6 +132,7 @@ void EncodeResponse(const Response& r, std::vector<uint8_t>& b) {
     PutU8(b, static_cast<uint8_t>(s.dims.size()));
     for (auto d : s.dims) PutI64(b, d);
   }
+  PutI32(b, r.process_set_id);
 }
 
 Response DecodeResponse(Reader& rd) {
@@ -154,6 +159,7 @@ Response DecodeResponse(Reader& rd) {
     for (uint8_t j = 0; j < ndim; ++j) s.dims.push_back(rd.I64());
     r.tensor_shapes.push_back(std::move(s));
   }
+  r.process_set_id = rd.I32();
   return r;
 }
 

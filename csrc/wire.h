@@ -27,6 +27,10 @@ struct Request {
   ReduceOp reduce_op = ReduceOp::SUM;
   double prescale_factor = 1.0;
   double postscale_factor = 1.0;
+  // Process-set scoping (0 = the global set); set_size lets the
+  // coordinator wait for exactly the members.
+  int32_t process_set_id = 0;
+  int32_t process_set_size = 0;
 };
 
 // What every rank must now execute, in identical order.
@@ -47,6 +51,8 @@ struct Response {
   // per tensor_names entry — authoritative on every rank, which keeps
   // response-cache parameters coherent (see engine.h ResponseCache).
   std::vector<TensorShape> tensor_shapes;
+  // Process-set scoping: non-members skip the response entirely.
+  int32_t process_set_id = 0;
 };
 
 // A response-cache hit event: this rank is ready to re-run the cached

@@ -96,7 +96,11 @@ class ResponseCache:
         """Returns (state, position).  Only ALLREDUCE is cacheable — the
         reference likewise caches only allreduce responses (allgather
         output sizes vary per step)."""
-        if not self.enabled or req.request_type != RequestType.ALLREDUCE:
+        if not self.enabled or req.request_type != RequestType.ALLREDUCE \
+                or req.process_set_id:
+            # Process-set ops bypass the cache: positions must stay
+            # coherent on EVERY rank, and non-members never see the
+            # set's traffic.
             return MISS, -1
         ent = self._entries.get(req.tensor_name)
         if ent is None:
@@ -153,7 +157,7 @@ class ResponseCache:
         ``resp.tensor_shapes`` — response-carried, so identical on every
         rank regardless of local request state."""
         if not self.enabled or resp.response_type != ResponseType.ALLREDUCE \
-                or resp.error_message:
+                or resp.error_message or resp.process_set_id:
             return
         have_shapes = len(resp.tensor_shapes) == len(resp.tensor_names)
         for i, name in enumerate(resp.tensor_names):

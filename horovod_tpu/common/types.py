@@ -219,6 +219,13 @@ class Request:
     reduce_op: ReduceOp = ReduceOp.SUM
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # Process-set scoping (beyond the reference; the project added
+    # process sets post-v0.19): 0 = the global set.  The id is a stable
+    # hash of the member ranks and ``process_set_size`` lets the
+    # coordinator wait for exactly the members without a registration
+    # round-trip.
+    process_set_id: int = 0
+    process_set_size: int = 0
 
 
 @dataclass
@@ -252,6 +259,9 @@ class Response:
     # joined ranks executing zero stand-ins), which keeps response-cache
     # parameters coherent without relying on rank-local request state.
     tensor_shapes: List["TensorShape"] = field(default_factory=list)
+    # Process-set scoping: non-member ranks skip the response entirely
+    # (0 = the global set, everyone executes).
+    process_set_id: int = 0
 
     def add_tensor_name(self, name: str) -> None:
         self.tensor_names.append(name)

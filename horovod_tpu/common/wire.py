@@ -13,7 +13,8 @@ Layout (all integers little-endian):
   Request  := u8 request_type, i32 request_rank, u8 tensor_type,
               varstr tensor_name, i32 root_rank, varstr device,
               u8 reduce_op, f64 prescale, f64 postscale,
-              u8 ndim, i64 dims[ndim]
+              u8 ndim, i64 dims[ndim],
+              i32 process_set_id, i32 process_set_size
   CacheHit := varstr name, u32 position
   RequestList  := u8 shutdown, u32 n, Request[n],
                   u32 n_hits, CacheHit[n_hits]
@@ -22,7 +23,8 @@ Layout (all integers little-endian):
               u32 n_devices, varstr[n_devices],
               u32 n_sizes, i64 sizes[n_sizes],
               u8 reduce_op, f64 prescale, f64 postscale,
-              u32 n_shapes, { u8 ndim, i64 dims[ndim] }[n_shapes]
+              u32 n_shapes, { u8 ndim, i64 dims[ndim] }[n_shapes],
+              i32 process_set_id
   ResponseList := u8 shutdown, u32 n, Response[n],
                   u32 n_hit_positions, u32 pos[n_hit_positions],
                   u32 n_resend, varstr resend_names[n_resend],
@@ -84,6 +86,7 @@ def encode_request(req: Request, buf: bytearray) -> None:
     buf += struct.pack("<B", len(dims))
     for d in dims:
         buf += struct.pack("<q", d)
+    buf += struct.pack("<ii", req.process_set_id, req.process_set_size)
 
 
 def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
@@ -102,6 +105,8 @@ def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
         (d,) = struct.unpack_from("<q", data, off)
         off += 8
         dims.append(d)
+    ps_id, ps_size = struct.unpack_from("<ii", data, off)
+    off += 8
     return Request(
         request_rank=rrank,
         request_type=RequestType(rtype),
@@ -113,6 +118,8 @@ def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
         reduce_op=ReduceOp(rop),
         prescale_factor=pre,
         postscale_factor=post,
+        process_set_id=ps_id,
+        process_set_size=ps_size,
     ), off
 
 
@@ -168,6 +175,7 @@ def encode_response(resp: Response, buf: bytearray) -> None:
         buf += struct.pack("<B", len(dims))
         for d in dims:
             buf += struct.pack("<q", d)
+    buf += struct.pack("<i", resp.process_set_id)
 
 
 def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
@@ -205,6 +213,8 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
             off += 8
             dims.append(d)
         shapes.append(TensorShape(dims))
+    (ps_id,) = struct.unpack_from("<i", data, off)
+    off += 4
     return Response(
         response_type=ResponseType(rtype),
         tensor_type=DataType(ttype),
@@ -216,6 +226,7 @@ def decode_response(data: bytes, off: int) -> Tuple[Response, int]:
         prescale_factor=pre,
         postscale_factor=post,
         tensor_shapes=shapes,
+        process_set_id=ps_id,
     ), off
 
 

@@ -93,16 +93,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvd_last_error.restype = c.c_char_p
     lib.hvd_allreduce_async.argtypes = [
         c.c_char_p, c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
-        c.c_int, c.c_double, c.c_double,
+        c.c_int, c.c_double, c.c_double, c.c_int, c.c_int,
     ]
     lib.hvd_allreduce_async.restype = c.c_int64
     lib.hvd_allgather_async.argtypes = [
         c.c_char_p, c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
+        c.c_int, c.c_int,
     ]
     lib.hvd_allgather_async.restype = c.c_int64
     lib.hvd_broadcast_async.argtypes = [
         c.c_char_p, c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
-        c.c_int,
+        c.c_int, c.c_int, c.c_int,
     ]
     lib.hvd_broadcast_async.restype = c.c_int64
     lib.hvd_alltoall_async.argtypes = [
@@ -112,9 +113,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvd_alltoall_async.restype = c.c_int64
     lib.hvd_reducescatter_async.argtypes = [
         c.c_char_p, c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
-        c.c_int,
+        c.c_int, c.c_int, c.c_int,
     ]
     lib.hvd_reducescatter_async.restype = c.c_int64
+    lib.hvd_register_process_set.argtypes = [
+        c.c_int, c.POINTER(c.c_int32), c.c_int,
+    ]
+    lib.hvd_register_process_set.restype = c.c_int64
     lib.hvd_poll.argtypes = [c.c_int64]
     lib.hvd_poll.restype = c.c_int
     lib.hvd_wait.argtypes = [c.c_int64]

@@ -212,6 +212,17 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
     return acc.astype(flat.dtype)
 
 
+def resp_group(engine, resp: Response):
+    """(member global ranks, my index) for a response — the full world
+    for the global set, the registered member list for a process set."""
+    if resp.process_set_id:
+        from horovod_tpu import process_sets
+
+        members = process_sets.ranks_of(resp.process_set_id)
+        return members, members.index(engine.rank)
+    return list(range(engine.size)), engine.rank
+
+
 class _AllreduceCandidate:
     """One entry of the allreduce dispatch chain (parity: the reference's
     per-category op list in ``ops/operation_manager.cc:37-104`` — ordered
@@ -220,25 +231,30 @@ class _AllreduceCandidate:
     def enabled(self, engine, resp: Response) -> bool:
         raise NotImplementedError
 
-    def execute(self, engine, flat: np.ndarray, op: ReduceOp) -> np.ndarray:
+    def execute(self, engine, flat: np.ndarray, op: ReduceOp,
+                group, me) -> np.ndarray:
         raise NotImplementedError
 
 
 class AdasumAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
-        return resp.reduce_op == ReduceOp.ADASUM
+        # Adasum's distance-doubling assumes the global power-of-two
+        # topology; process sets fall through to the ring.
+        return resp.reduce_op == ReduceOp.ADASUM \
+            and not resp.process_set_id
 
-    def execute(self, engine, flat, op):
+    def execute(self, engine, flat, op, group, me):
         return _adasum_flat(engine, flat)
 
 
 class HierarchicalAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
         return (resp.reduce_op != ReduceOp.ADASUM
+                and not resp.process_set_id
                 and getattr(engine, "hierarchical_allreduce", False)
                 and engine.hierarchical_topology_ok())
 
-    def execute(self, engine, flat, op):
+    def execute(self, engine, flat, op, group, me):
         return hierarchical_allreduce_flat(engine, flat, op)
 
 
@@ -246,8 +262,8 @@ class RingAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
         return True
 
-    def execute(self, engine, flat, op):
-        return ring_allreduce_flat(engine, flat, op)
+    def execute(self, engine, flat, op, group, me):
+        return _ring_allreduce_group(engine, flat, op, group, me)
 
 
 # Priority order mirrors the reference's CreateOperationManager chain
@@ -273,14 +289,17 @@ def allreduce(engine, entries, resp: Response):
         else:
             flat = flat * dtype.type(prescale)
 
+    group, me = resp_group(engine, resp)
     reduced = next(c for c in ALLREDUCE_CHAIN
-                   if c.enabled(engine, resp)).execute(engine, flat, op)
+                   if c.enabled(engine, resp)).execute(engine, flat, op,
+                                                       group, me)
 
     if op == ReduceOp.AVERAGE:
+        n = len(group)
         if _needs_f32_math(dtype):
-            reduced = (reduced.astype(np.float32) / engine.size).astype(dtype)
+            reduced = (reduced.astype(np.float32) / n).astype(dtype)
         else:
-            reduced = reduced / dtype.type(engine.size)
+            reduced = reduced / dtype.type(n)
     if postscale != 1.0:
         reduced = (reduced * postscale).astype(dtype, copy=False)
 
@@ -351,7 +370,8 @@ def _allgather_hierarchical(engine, entries, resp: Response):
 
 class HierarchicalAllgather:
     def enabled(self, engine, resp):
-        return (getattr(engine, "hierarchical_allgather", False)
+        return (not resp.process_set_id
+                and getattr(engine, "hierarchical_allgather", False)
                 and engine.hierarchical_topology_ok())
 
     def execute(self, engine, entries, resp):
@@ -376,21 +396,24 @@ def allgather(engine, entries, resp: Response):
 
 
 def _allgather_flat(engine, entries, resp: Response):
-    """Ragged ring allgatherv; one entry per response."""
-    size, rank = engine.size, engine.rank
+    """Ragged ring allgatherv; one entry per response.  For a process
+    set, the ring walks the member list (``resp.tensor_sizes`` is in
+    member order)."""
+    group, me = resp_group(engine, resp)
+    size = len(group)
     results = []
     for e in entries:
         first_dims = resp.tensor_sizes
         rest_shape = e.array.shape[1:] if e.array.ndim > 0 else ()
         dtype = _np_dtype(resp.tensor_type)
         blocks: List[Optional[np.ndarray]] = [None] * size
-        blocks[rank] = np.ascontiguousarray(e.array)
+        blocks[me] = np.ascontiguousarray(e.array)
         if size > 1:
-            right = engine._data[(rank + 1) % size]
-            left = engine._data[(rank - 1) % size]
+            right = engine._data[group[(me + 1) % size]]
+            left = engine._data[group[(me - 1) % size]]
             for step in range(size - 1):
-                send_idx = (rank - step) % size
-                recv_idx = (rank - step - 1) % size
+                send_idx = (me - step) % size
+                recv_idx = (me - step - 1) % size
                 t = _send_async(right, blocks[send_idx].tobytes())
                 payload = _recv(left)
                 t.join()
@@ -398,7 +421,7 @@ def _allgather_flat(engine, entries, resp: Response):
                 blocks[recv_idx] = blk.reshape(
                     (first_dims[recv_idx],) + rest_shape)
         results.append(np.concatenate(blocks, axis=0)
-                       if size > 1 else blocks[rank].copy())
+                       if size > 1 else blocks[me].copy())
     return results
 
 
@@ -412,7 +435,8 @@ def reducescatter(engine, entries, resp: Response):
     one virtual rank so each rank finishes owning its own chunk; the
     chunk boundaries align to dim-0 rows, not the flat element split.
     """
-    size, rank = engine.size, engine.rank
+    group, me = resp_group(engine, resp)
+    size = len(group)
     op = resp.reduce_op
     dtype = _np_dtype(resp.tensor_type)
     results = []
@@ -426,19 +450,19 @@ def reducescatter(engine, entries, resp: Response):
             continue
         chunks = [arr[bounds[i]:bounds[i + 1]].copy()
                   for i in range(size)]
-        right = engine._data[(rank + 1) % size]
-        left = engine._data[(rank - 1) % size]
-        # Virtual rank (rank-1): the standard walk leaves rank r owning
+        right = engine._data[group[(me + 1) % size]]
+        left = engine._data[group[(me - 1) % size]]
+        # Virtual rank (me-1): the standard walk leaves member r owning
         # chunk (r+1)%size; shifting by one leaves it owning chunk r.
         for step in range(size - 1):
-            send_idx = (rank - 1 - step) % size
-            recv_idx = (rank - 2 - step) % size
+            send_idx = (me - 1 - step) % size
+            recv_idx = (me - 2 - step) % size
             t = _send_async(right, chunks[send_idx].tobytes())
             incoming = np.frombuffer(_recv(left), dtype=dtype).reshape(
                 (bounds[recv_idx + 1] - bounds[recv_idx],) + rest).copy()
             t.join()
             chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
-        out = chunks[rank]
+        out = chunks[me]
         if op == ReduceOp.AVERAGE:
             if _needs_f32_math(dtype):
                 out = (out.astype(np.float32) / size).astype(dtype)
@@ -449,18 +473,19 @@ def reducescatter(engine, entries, resp: Response):
 
 
 def broadcast(engine, entries, resp: Response):
-    size, rank = engine.size, engine.rank
+    group, _me = resp_group(engine, resp)
+    rank = engine.rank
     results = []
     for e in entries:
         root = int(resp.tensor_sizes[0]) if resp.tensor_sizes \
-            else e.root_rank
-        if size == 1:
+            else e.root_rank  # root is a GLOBAL rank (set member)
+        if len(group) == 1:
             results.append(e.array.copy())
             continue
         if rank == root:
             payload = np.ascontiguousarray(e.array).tobytes()
             threads = [_send_async(engine._data[r], payload)
-                       for r in range(size) if r != root]
+                       for r in group if r != root]
             for t in threads:
                 t.join()
             results.append(e.array.copy())

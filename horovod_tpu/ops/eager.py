@@ -115,7 +115,7 @@ def allreduce_async(tensor, average: Optional[bool] = None,
                     op: Optional[ReduceOp] = None,
                     prescale_factor: float = 1.0,
                     postscale_factor: float = 1.0,
-                    compression=None) -> int:
+                    compression=None, process_set=None) -> int:
     """Positional order matches horovod 0.19 (tensor, average, name) so
     ported calls like ``allreduce_async(grad, False)`` keep their meaning
     (torch/mpi_ops.py:94-129)."""
@@ -128,7 +128,8 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     comp_arr, ctx = _np_compress(compression, arr)
     h = basics._engine().allreduce_async(
         _auto_name("allreduce", name), comp_arr, op=op,
-        prescale=prescale_factor, postscale=postscale_factor)
+        prescale=prescale_factor, postscale=postscale_factor,
+        process_set=process_set)
 
     def post(raw):
         raw = _np_decompress(compression, raw, ctx)
@@ -174,10 +175,10 @@ def allreduce(tensor, average: Optional[bool] = None,
               op: Optional[ReduceOp] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
-              compression=None):
+              compression=None, process_set=None):
     return synchronize(allreduce_async(
         tensor, average, name, op, prescale_factor, postscale_factor,
-        compression))
+        compression, process_set))
 
 
 def grouped_allreduce(tensors: List, average: Optional[bool] = None,
@@ -192,14 +193,16 @@ def grouped_allreduce(tensors: List, average: Optional[bool] = None,
     return [synchronize(h) for h in handles]
 
 
-def allgather_async(tensor, name: Optional[str] = None) -> int:
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> int:
     arr, restore = _to_numpy(tensor)
-    h = basics._engine().allgather_async(_auto_name("allgather", name), arr)
+    h = basics._engine().allgather_async(
+        _auto_name("allgather", name), arr, process_set=process_set)
     return _register(h, restore)
 
 
-def allgather(tensor, name: Optional[str] = None):
-    return synchronize(allgather_async(tensor, name))
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    return synchronize(allgather_async(tensor, name, process_set))
 
 
 def sparse_allreduce(values, indices, average: Optional[bool] = None,
@@ -231,7 +234,8 @@ def sparse_allreduce(values, indices, average: Optional[bool] = None,
 
 def reducescatter_async(tensor, average: Optional[bool] = None,
                         name: Optional[str] = None,
-                        op: Optional[ReduceOp] = None) -> int:
+                        op: Optional[ReduceOp] = None,
+                        process_set=None) -> int:
     """Reduce across ranks, scatter over dim 0 (rank r gets the r-th
     near-equal row chunk).  The reference project added
     ``hvd.reducescatter`` right after the v0.19 line; the in-graph twin
@@ -248,26 +252,31 @@ def reducescatter_async(tensor, average: Optional[bool] = None,
             "(got a scalar)")
     arr, restore = _to_numpy(tensor)
     h = basics._engine().reducescatter_async(
-        _auto_name("reducescatter", name), arr, op=rop)
+        _auto_name("reducescatter", name), arr, op=rop,
+        process_set=process_set)
     return _register(h, restore)
 
 
 def reducescatter(tensor, average: Optional[bool] = None,
                   name: Optional[str] = None,
-                  op: Optional[ReduceOp] = None):
-    return synchronize(reducescatter_async(tensor, average, name, op))
+                  op: Optional[ReduceOp] = None, process_set=None):
+    return synchronize(reducescatter_async(tensor, average, name, op,
+                                           process_set))
 
 
 def broadcast_async(tensor, root_rank: int = 0,
-                    name: Optional[str] = None) -> int:
+                    name: Optional[str] = None, process_set=None) -> int:
     arr, restore = _to_numpy(tensor)
     h = basics._engine().broadcast_async(
-        _auto_name("broadcast", name), arr, root_rank=root_rank)
+        _auto_name("broadcast", name), arr, root_rank=root_rank,
+        process_set=process_set)
     return _register(h, restore)
 
 
-def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
-    return synchronize(broadcast_async(tensor, root_rank, name))
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
+              process_set=None):
+    return synchronize(broadcast_async(tensor, root_rank, name,
+                                       process_set))
 
 
 def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:

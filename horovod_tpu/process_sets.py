@@ -1,0 +1,123 @@
+"""Process sets: collectives over subgroups of ranks.
+
+Beyond the v0.19 reference (the project added process sets later):
+``ProcessSet([0, 2])`` scopes an eager collective to a subset of ranks —
+
+    ps = hvd.ProcessSet([0, 2])
+    if ps.included():
+        out = hvd.allreduce(x, process_set=ps)
+
+Design (TPU-first redesign, not a port):
+
+* A set's identity is a **stable hash of its sorted member ranks** — no
+  registration round-trip; every rank that constructs the same member
+  list gets the same id.  Requests carry ``(id, size)``, so the
+  coordinator can wait for exactly the members without global state.
+* ``ProcessSet`` must be constructed identically on **every** rank
+  (members and non-members), like the reference requires: the response
+  stream reaches all ranks, and non-members need the registry to know
+  to skip a set's responses.
+* The data plane reuses the full TCP mesh — subgroup rings walk the
+  member list in sorted order over the existing peer sockets, with the
+  same chunk math as the global ring (mixed native/py bit-compatible).
+* ``join``/``barrier``/``alltoall`` stay global-set-only; the in-graph
+  regime expresses subgroups as mesh axes instead (docs/parallelism.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+_lock = threading.Lock()
+_registry: Dict[int, List[int]] = {}
+
+GLOBAL_ID = 0
+
+
+def _set_id(ranks: Sequence[int]) -> int:
+    """FNV-1a over the member ranks, folded to a positive int32 != 0."""
+    h = 2166136261
+    for r in ranks:
+        for b in int(r).to_bytes(4, "little", signed=False):
+            h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    h &= 0x7FFFFFFF
+    return h or 1
+
+
+class ProcessSet:
+    """A fixed subgroup of global ranks (sorted, duplicates removed).
+
+    Construct on every rank with the same member list.  Pass via the
+    ``process_set=`` argument of eager collectives."""
+
+    def __init__(self, ranks: Sequence[int]):
+        members = sorted({int(r) for r in ranks})
+        if not members:
+            raise ValueError("a process set needs at least one rank")
+        if members[0] < 0:
+            raise ValueError(f"negative rank in process set: {members}")
+        self.ranks: List[int] = members
+        self.process_set_id = _set_id(members)
+        with _lock:
+            prev = _registry.get(self.process_set_id)
+            if prev is not None and prev != members:
+                raise ValueError(
+                    f"process-set id collision: {members} vs {prev}")
+            _registry[self.process_set_id] = members
+        # The native engine keeps its own registry (the C++ coordinator
+        # and the skip path consult it); tell it about this set if it is
+        # already running — NativeEngine syncs the snapshot otherwise.
+        from horovod_tpu import basics
+
+        eng = basics._runtime
+        if eng is not None and hasattr(eng, "register_process_set"):
+            eng.register_process_set(self.process_set_id, members)
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank within the set, or -1 if not a member."""
+        from horovod_tpu import basics
+
+        try:
+            return self.ranks.index(basics.rank())
+        except ValueError:
+            return -1
+
+    def included(self) -> bool:
+        return self.rank() >= 0
+
+    def validate(self, rank: int, world_size: int):
+        """Shared enqueue-side validation for every engine; returns the
+        (id, size) request fields."""
+        if rank not in self.ranks:
+            raise ValueError(f"rank {rank} is not a member of {self}")
+        if self.ranks[-1] >= world_size:
+            raise ValueError(
+                f"{self} has ranks outside the world [0, {world_size})")
+        return self.process_set_id, len(self.ranks)
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(ranks={self.ranks}, id={self.process_set_id})"
+
+
+def ranks_of(set_id: int) -> Optional[List[int]]:
+    """Member ranks of a registered set (None if unknown here)."""
+    if set_id == GLOBAL_ID:
+        return None
+    with _lock:
+        return _registry.get(set_id)
+
+
+def snapshot() -> Dict[int, List[int]]:
+    """All registered sets (engine-creation sync)."""
+    with _lock:
+        return dict(_registry)
+
+
+def reset() -> None:
+    """Testing hook: forget all registered sets."""
+    with _lock:
+        _registry.clear()

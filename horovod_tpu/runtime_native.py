@@ -109,6 +109,18 @@ class NativeEngine:
         self._meta_lock = threading.Lock()
         self._shutdown = False
 
+        # Sets constructed before the engine existed (the coordinator and
+        # non-members need the registry for lookup/skip, not just members
+        # at enqueue time).
+        from horovod_tpu import process_sets as _ps
+
+        for sid, ranks in _ps.snapshot().items():
+            self.register_process_set(sid, ranks)
+
+    def register_process_set(self, set_id, ranks):
+        arr = (ctypes.c_int32 * len(ranks))(*ranks)
+        self._lib.hvd_register_process_set(set_id, arr, len(ranks))
+
     @staticmethod
     def _autotune_args(hierarchical_ok: bool = False):
         """hvd_create's autotune tail, from the shared env policy (single
@@ -145,16 +157,26 @@ class NativeEngine:
             raise ValueError(msg)
         raise RuntimeError(msg)
 
+
+    def _ps_args(self, process_set):
+        """Validate a ProcessSet and return the (id, size) C-API args.
+        (Registration with the C++ core happens at ProcessSet
+        construction / engine startup, not per call.)"""
+        if process_set is None:
+            return 0, 0
+        return process_set.validate(self.rank, self.size)
+
     def allreduce_async(self, name, array, op=ReduceOp.SUM,
-                        prescale=1.0, postscale=1.0):
+                        prescale=1.0, postscale=1.0, process_set=None):
         arr = np.ascontiguousarray(array)
         if arr is array:  # in-place op: never clobber the caller's array
             arr = arr.copy()
         dt = dtype_from_numpy(arr.dtype)
         nd, dims = self._dims(arr)
+        ps_id, ps_size = self._ps_args(process_set)
         h = self._lib.hvd_allreduce_async(
             name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
-            int(dt), int(op), prescale, postscale)
+            int(dt), int(op), prescale, postscale, ps_id, ps_size)
         if h < 0:
             self._raise_enqueue_error()
         with self._meta_lock:
@@ -162,13 +184,14 @@ class NativeEngine:
                 RequestType.ALLREDUCE, arr, dt, arr.shape)
         return h
 
-    def allgather_async(self, name, array):
+    def allgather_async(self, name, array, process_set=None):
         arr = np.ascontiguousarray(array)
         dt = dtype_from_numpy(arr.dtype)
         nd, dims = self._dims(arr)
+        ps_id, ps_size = self._ps_args(process_set)
         h = self._lib.hvd_allgather_async(
             name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
-            int(dt))
+            int(dt), ps_id, ps_size)
         if h < 0:
             self._raise_enqueue_error()
         with self._meta_lock:
@@ -176,7 +199,8 @@ class NativeEngine:
                 RequestType.ALLGATHER, arr, dt, arr.shape)
         return h
 
-    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM,
+                            process_set=None):
         arr = np.ascontiguousarray(array)
         if arr.ndim == 0:
             raise ValueError(
@@ -184,8 +208,10 @@ class NativeEngine:
                 "over (got a scalar)")
         dt = dtype_from_numpy(arr.dtype)
         nd, dims = self._dims(arr)
+        ps_id, ps_size = self._ps_args(process_set)
         h = self._lib.hvd_reducescatter_async(
-            name.encode(), arr.ctypes.data, nd, dims, int(dt), int(op))
+            name.encode(), arr.ctypes.data, nd, dims, int(dt), int(op),
+            ps_id, ps_size)
         if h < 0:
             self._raise_enqueue_error()
         with self._meta_lock:
@@ -193,15 +219,22 @@ class NativeEngine:
                 RequestType.REDUCESCATTER, arr, dt, arr.shape)
         return h
 
-    def broadcast_async(self, name, array, root_rank=0):
+    def broadcast_async(self, name, array, root_rank=0,
+                        process_set=None):
         arr = np.ascontiguousarray(array)
         if arr is array:
             arr = arr.copy()
         dt = dtype_from_numpy(arr.dtype)
         nd, dims = self._dims(arr)
+        ps_id, ps_size = self._ps_args(process_set)
+        if process_set is not None and \
+                root_rank not in process_set.ranks:
+            raise ValueError(
+                f"broadcast root rank {root_rank} (global) is not a "
+                f"member of {process_set}")
         h = self._lib.hvd_broadcast_async(
             name.encode(), arr.ctypes.data, nd if arr.ndim else 0, dims,
-            int(dt), root_rank)
+            int(dt), root_rank, ps_id, ps_size)
         if h < 0:
             self._raise_enqueue_error()
         with self._meta_lock:

@@ -138,11 +138,25 @@ class _MessageTable:
         self.entries: Dict[str, List[Request]] = {}
         self.first_seen: Dict[str, float] = {}
 
+    @staticmethod
+    def key_of(req: Request) -> str:
+        """Table key: process-set requests are scoped by set id, so the
+        same tensor name may be in flight in two different sets at once
+        (both subgroups allreducing "grad.w" is legitimate traffic)."""
+        if req.process_set_id:
+            return f"{req.tensor_name}@ps{req.process_set_id}"
+        return req.tensor_name
+
     def increment(self, req: Request, joined_size: int) -> bool:
-        """Record a rank's readiness; True when all non-joined ranks are in."""
-        lst = self.entries.setdefault(req.tensor_name, [])
+        """Record a rank's readiness; True when all non-joined ranks are
+        in (for a process-set request: when every member is in — join is
+        global-set-only, so joined_size does not apply)."""
+        key = self.key_of(req)
+        lst = self.entries.setdefault(key, [])
         lst.append(req)
-        self.first_seen.setdefault(req.tensor_name, time.monotonic())
+        self.first_seen.setdefault(key, time.monotonic())
+        if req.process_set_id:
+            return len(lst) == req.process_set_size
         return len(lst) == self.size - joined_size
 
     def pop(self, name: str) -> List[Request]:
@@ -223,8 +237,14 @@ class SingleProcessEngine(_EngineBase):
         self.handles.mark_done(h, Status.ok(), result)
         return h
 
+    def _check_ps(self, process_set):
+        # size 1: the only valid set is {0} (shared validation helper).
+        if process_set is not None:
+            process_set.validate(0, 1)
+
     def allreduce_async(self, name, array, op=ReduceOp.SUM,
-                        prescale=1.0, postscale=1.0):
+                        prescale=1.0, postscale=1.0, process_set=None):
+        self._check_ps(process_set)
         out = np.asarray(array)
         if prescale != 1.0 or postscale != 1.0:
             out = out * (prescale * postscale)
@@ -232,16 +252,20 @@ class SingleProcessEngine(_EngineBase):
             out = out.copy()
         return self._finish(name, "ALLREDUCE", out)
 
-    def allgather_async(self, name, array):
+    def allgather_async(self, name, array, process_set=None):
+        self._check_ps(process_set)
         return self._finish(name, "ALLGATHER", np.asarray(array).copy())
 
-    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM,
+                            process_set=None):
         # size 1: the reduction of one rank's tensor, scattered to the
         # one rank — the input itself.
+        self._check_ps(process_set)
         return self._finish(name, "REDUCESCATTER",
                             np.asarray(array).copy())
 
-    def broadcast_async(self, name, array, root_rank=0):
+    def broadcast_async(self, name, array, root_rank=0, process_set=None):
+        self._check_ps(process_set)
         if root_rank != 0:
             raise ValueError(
                 f"broadcast root rank {root_rank} out of range for size 1")
@@ -388,9 +412,16 @@ class PyEngine(_EngineBase):
             self._request_queue.append(entry.request)
         return entry.handle
 
+    def _ps_fields(self, process_set):
+        """Validate + unpack a ProcessSet into (id, size) request fields."""
+        if process_set is None:
+            return 0, 0
+        return process_set.validate(self.rank, self.size)
+
     def allreduce_async(self, name, array, op=ReduceOp.SUM,
-                        prescale=1.0, postscale=1.0):
+                        prescale=1.0, postscale=1.0, process_set=None):
         arr = np.ascontiguousarray(array)
+        ps_id, ps_size = self._ps_fields(process_set)
         req = Request(
             request_rank=self.rank,
             request_type=RequestType.ALLREDUCE,
@@ -401,12 +432,15 @@ class PyEngine(_EngineBase):
             reduce_op=op,
             prescale_factor=prescale,
             postscale_factor=postscale,
+            process_set_id=ps_id,
+            process_set_size=ps_size,
         )
         h = self.handles.allocate()
         return self._enqueue(TensorTableEntry(name, arr, h, req))
 
-    def allgather_async(self, name, array):
+    def allgather_async(self, name, array, process_set=None):
         arr = np.ascontiguousarray(array)
+        ps_id, ps_size = self._ps_fields(process_set)
         req = Request(
             request_rank=self.rank,
             request_type=RequestType.ALLGATHER,
@@ -414,16 +448,20 @@ class PyEngine(_EngineBase):
             tensor_name=name,
             device="cpu",
             tensor_shape=TensorShape(arr.shape),
+            process_set_id=ps_id,
+            process_set_size=ps_size,
         )
         h = self.handles.allocate()
         return self._enqueue(TensorTableEntry(name, arr, h, req))
 
-    def reducescatter_async(self, name, array, op=ReduceOp.SUM):
+    def reducescatter_async(self, name, array, op=ReduceOp.SUM,
+                            process_set=None):
         arr = np.ascontiguousarray(array)
         if arr.ndim == 0:
             raise ValueError(
                 "reducescatter needs at least one dimension to scatter "
                 "over (got a scalar)")
+        ps_id, ps_size = self._ps_fields(process_set)
         req = Request(
             request_rank=self.rank,
             request_type=RequestType.REDUCESCATTER,
@@ -432,16 +470,24 @@ class PyEngine(_EngineBase):
             device="cpu",
             tensor_shape=TensorShape(arr.shape),
             reduce_op=op,
+            process_set_id=ps_id,
+            process_set_size=ps_size,
         )
         h = self.handles.allocate()
         return self._enqueue(TensorTableEntry(name, arr, h, req))
 
-    def broadcast_async(self, name, array, root_rank=0):
+    def broadcast_async(self, name, array, root_rank=0, process_set=None):
         arr = np.ascontiguousarray(array)
         if not (0 <= root_rank < self.size):
             raise ValueError(
                 f"broadcast root rank {root_rank} out of range "
                 f"[0, {self.size})")
+        ps_id, ps_size = self._ps_fields(process_set)
+        if process_set is not None and \
+                root_rank not in process_set.ranks:
+            raise ValueError(
+                f"broadcast root rank {root_rank} (global) is not a "
+                f"member of {process_set}")
         req = Request(
             request_rank=self.rank,
             request_type=RequestType.BROADCAST,
@@ -450,6 +496,8 @@ class PyEngine(_EngineBase):
             device="cpu",
             tensor_shape=TensorShape(arr.shape),
             root_rank=root_rank,
+            process_set_id=ps_id,
+            process_set_size=ps_size,
         )
         h = self.handles.allocate()
         return self._enqueue(
@@ -689,9 +737,12 @@ class PyEngine(_EngineBase):
             if req.request_type == RequestType.JOIN:
                 self._joined_ranks.add(req.request_rank)
                 self._last_joined_rank = req.request_rank
-                # Tensors waiting only on joined ranks become ready.
+                # Tensors waiting only on joined ranks become ready
+                # (global-set entries only; join never applies to
+                # process-set traffic).
                 for nm, lst in list(self._msg_table.entries.items()):
-                    if len(lst) == self.size - len(self._joined_ranks):
+                    if lst[0].process_set_id == 0 and \
+                            len(lst) == self.size - len(self._joined_ranks):
                         if nm not in ready:
                             ready.append(nm)
                 return
@@ -702,7 +753,7 @@ class PyEngine(_EngineBase):
                 self.timeline.negotiate_rank_ready(
                     req.tensor_name, req.request_rank)
             if self._msg_table.increment(req, len(self._joined_ranks)):
-                ready.append(req.tensor_name)
+                ready.append(_MessageTable.key_of(req))
 
         def _absorb_hit(name: str, pos: int, rank: int) -> None:
             # A hit event stands for the full Request; rebuild it from
@@ -735,11 +786,15 @@ class PyEngine(_EngineBase):
 
         responses: List[Response] = []
         hit_positions: List[int] = []
-        for name in ready:
-            reqs = self._msg_table.pop(name)
+        for key in ready:
+            reqs = self._msg_table.pop(key)
+            name = reqs[0].tensor_name  # key may be set-scoped
             if self.timeline.enabled:
                 self.timeline.negotiate_end(name)
-            hit_ranks = self._hit_ranks.pop(name, set())
+            # Hits are global-set-only, where key == name; popping by key
+            # keeps a set-scoped completion from stealing a same-named
+            # global tensor's hit record.
+            hit_ranks = self._hit_ranks.pop(key, set())
             contributors = {r.request_rank for r in reqs}
             ent_pos = -1
             if hit_ranks >= contributors:
@@ -848,6 +903,15 @@ class PyEngine(_EngineBase):
             err = (f"Mismatched collective operations for tensor {name}: "
                    + ", ".join(sorted({_OP_NAMES[r.request_type]
                                        for r in reqs})))
+        elif any(r.process_set_id != first.process_set_id or
+                 r.process_set_size != first.process_set_size
+                 for r in reqs):
+            err = f"Mismatched process sets for tensor {name}"
+        elif first.process_set_id and first.request_type in (
+                RequestType.ALLTOALL, RequestType.JOIN,
+                RequestType.BARRIER):
+            err = (f"{_OP_NAMES[first.request_type]} does not support "
+                   f"process sets (tensor {name})")
         elif any(r.tensor_type != first.tensor_type for r in reqs):
             err = (f"Mismatched data types for tensor {name}: "
                    + ", ".join(sorted({r.tensor_type.name for r in reqs})))
@@ -858,6 +922,10 @@ class PyEngine(_EngineBase):
                                            for r in reqs})))
             elif any(r.reduce_op != first.reduce_op for r in reqs):
                 err = f"Mismatched reduce ops for tensor {name}"
+            elif first.process_set_id and \
+                    first.reduce_op == ReduceOp.ADASUM:
+                err = (f"Adasum is not supported with process sets "
+                       f"(tensor {name})")
         elif first.request_type == RequestType.BROADCAST:
             if any(r.root_rank != first.root_rank for r in reqs):
                 err = (f"Mismatched broadcast root ranks for {name}: "
@@ -865,6 +933,17 @@ class PyEngine(_EngineBase):
                                            for r in reqs})))
             elif any(r.tensor_shape != first.tensor_shape for r in reqs):
                 err = f"Mismatched broadcast tensor shapes for {name}"
+            elif first.process_set_id:
+                from horovod_tpu import process_sets
+
+                members = process_sets.ranks_of(first.process_set_id)
+                if members is not None and \
+                        first.root_rank not in members:
+                    # Authoritative check (wrappers pre-check too): a
+                    # non-member root would skip while members block.
+                    err = (f"broadcast root rank {first.root_rank} is "
+                           f"not a member of process set "
+                           f"{first.process_set_id} (tensor {name})")
         elif first.request_type == RequestType.ALLGATHER:
             for r in reqs:
                 if r.tensor_shape.rank != first.tensor_shape.rank or \
@@ -893,6 +972,7 @@ class PyEngine(_EngineBase):
             tensor_names=[name],
             tensor_type=first.tensor_type,
             devices=[first.device],
+            process_set_id=first.process_set_id,
         )
         if first.request_type == RequestType.ALLREDUCE:
             resp.tensor_sizes = [first.tensor_shape.num_elements]
@@ -903,11 +983,27 @@ class PyEngine(_EngineBase):
             # coherent on every rank (incl. joined ranks' stand-ins).
             resp.tensor_shapes = [first.tensor_shape]
         elif first.request_type == RequestType.ALLGATHER:
-            # First-dim size per rank, in rank order (0 for joined ranks).
+            # First-dim size per rank, in rank order (0 for joined
+            # ranks); for a process set, per member in member order.
             by_rank = {r.request_rank: r for r in reqs}
+            if first.process_set_id:
+                from horovod_tpu import process_sets
+
+                members = process_sets.ranks_of(first.process_set_id)
+                if members is None:
+                    return Response(
+                        response_type=ResponseType.ERROR,
+                        tensor_names=[name],
+                        error_message=(
+                            f"process set {first.process_set_id} is not "
+                            "registered on the coordinator (construct "
+                            "the ProcessSet on every rank)"))
+                order = members
+            else:
+                order = range(self.size)
             resp.tensor_sizes = [
                 by_rank[r].tensor_shape.dims[0] if r in by_rank else 0
-                for r in range(self.size)]
+                for r in order]
         elif first.request_type == RequestType.BROADCAST:
             resp.tensor_sizes = [first.root_rank]
         elif first.request_type == RequestType.REDUCESCATTER:
@@ -938,6 +1034,7 @@ class PyEngine(_EngineBase):
                     pending.reduce_op == r.reduce_op and \
                     pending.prescale_factor == r.prescale_factor and \
                     pending.postscale_factor == r.postscale_factor and \
+                    pending.process_set_id == r.process_set_id and \
                     pending_bytes + nbytes <= self.fusion_threshold:
                 pending.tensor_names.extend(r.tensor_names)
                 pending.tensor_sizes.extend(r.tensor_sizes)
@@ -989,6 +1086,17 @@ class PyEngine(_EngineBase):
     def _perform_operation(self, resp: Response,
                            from_cache: bool = False) -> None:
         from horovod_tpu.ops import cpu_backend
+
+        if resp.process_set_id and \
+                resp.response_type != ResponseType.ERROR:
+            # Process-set responses reach every rank in the response
+            # stream; non-members simply skip (members always have the
+            # entries — join is global-set-only, so no stand-ins here).
+            from horovod_tpu import process_sets
+
+            members = process_sets.ranks_of(resp.process_set_id)
+            if members is None or self.rank not in members:
+                return
 
         if resp.response_type == ResponseType.JOIN:
             self._last_joined_rank = int(resp.tensor_sizes[0]) \

@@ -247,6 +247,94 @@ def scenario_hier_vs_flat():
     np.testing.assert_array_equal(out, expect)
 
 
+def scenario_process_sets():
+    """Subgroup collectives: evens / odds / a pair, interleaved with
+    global traffic.  Non-members must skip cleanly; results match the
+    per-set oracle."""
+    rank, size = hvd.rank(), hvd.size()
+    assert size >= 3, "scenario needs >= 3 ranks"
+    evens = hvd.ProcessSet(range(0, size, 2))
+    odds = hvd.ProcessSet(range(1, size, 2))
+    pair = hvd.ProcessSet([0, size - 1])
+    mine = [ps for ps in (evens, odds, pair) if ps.included()]
+
+    # set allreduce (Sum) interleaved with a global allreduce
+    for ps in mine:
+        x = np.full(5, float(rank + 1), np.float32)
+        out = hvd.allreduce(x, op=hvd.Sum, name=f"ps.{ps.process_set_id}.ar",
+                            process_set=ps)
+        np.testing.assert_allclose(
+            out, sum(r + 1.0 for r in ps.ranks))
+    g = hvd.allreduce(np.ones(3, np.float32), op=hvd.Sum, name="ps.global")
+    np.testing.assert_allclose(g, float(size))
+    # SAME tensor name concurrently in different sets (both subgroups
+    # allreducing "grad.w" is legitimate traffic — the coordinator keys
+    # its table by (set, name))
+    my_ep = evens if evens.included() else odds
+    out = hvd.allreduce(np.full(2, float(rank + 1), np.float32),
+                        op=hvd.Sum, name="ps.shared_name",
+                        process_set=my_ep)
+    np.testing.assert_allclose(out, sum(r + 1.0 for r in my_ep.ranks))
+
+    # set allgather: member r contributes (set-rank + 1) rows
+    for ps in mine:
+        sr = ps.rank()
+        x = np.full((sr + 1, 2), float(rank), np.float32)
+        out = hvd.allgather(x, name=f"ps.{ps.process_set_id}.ag",
+                            process_set=ps)
+        expect = np.concatenate(
+            [np.full((i + 1, 2), float(gr), np.float32)
+             for i, gr in enumerate(ps.ranks)])
+        np.testing.assert_allclose(out, expect)
+
+    # set broadcast from the set's LAST member (a global rank id)
+    for ps in mine:
+        root = ps.ranks[-1]
+        x = np.full(4, float(rank + 10), np.float32)
+        out = hvd.broadcast(x, root_rank=root,
+                            name=f"ps.{ps.process_set_id}.bc",
+                            process_set=ps)
+        np.testing.assert_allclose(out, float(root + 10))
+
+    # set reducescatter over an uneven dim 0
+    for ps in mine:
+        n = ps.size()
+        d0 = 2 * n + 1
+        x = np.outer(np.arange(d0, dtype=np.float32) + 1,
+                     np.ones(2, np.float32)) * (rank + 1)
+        out = hvd.reducescatter(x, op=hvd.Sum,
+                                name=f"ps.{ps.process_set_id}.rs",
+                                process_set=ps)
+        total = sum(r + 1 for r in ps.ranks)
+        base, rem = divmod(d0, n)
+        sr = ps.rank()
+        lo = sr * base + min(sr, rem)
+        hi = lo + base + (1 if sr < rem else 0)
+        np.testing.assert_allclose(
+            out, np.outer(np.arange(lo, hi, dtype=np.float32) + 1,
+                          np.ones(2, np.float32)) * total)
+
+    # misuse: non-member enqueue is a local error
+    if not pair.included():
+        try:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                          name="ps.bad", process_set=pair)
+            raise AssertionError("expected non-member ValueError")
+        except ValueError as e:
+            assert "not a member" in str(e), e
+    # misuse: Adasum + process set is a named coordinator error
+    if evens.included():
+        try:
+            hvd.allreduce(np.ones(2, np.float32), op=hvd.Adasum,
+                          name="ps.adasum", process_set=evens)
+            raise AssertionError("expected Adasum/process-set error")
+        except RuntimeError as e:
+            assert "Adasum is not supported with process sets" in str(e), e
+    # Set membership makes per-rank op counts asymmetric; sync before the
+    # worker's shutdown so no rank tears the mesh down mid-collective.
+    hvd.barrier()
+
+
 def scenario_random_ops():
     """Randomized differential test: every rank derives the SAME random
     op sequence from HVD_FUZZ_SEED and checks each result against a

@@ -250,6 +250,14 @@ def test_random_ops_differential(seed, engine):
                            "HVD_FUZZ_OPS": "40"})
 
 
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+@pytest.mark.parametrize("np_", [3, 4])
+def test_process_sets(np_, engine):
+    """Subgroup collectives (evens/odds/pair) interleaved with global
+    traffic, across engines — the mixed gang pins the wire fields."""
+    run_workers("process_sets", np_, engine=engine)
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_random_ops_differential_hierarchical(engine):
     """The same fuzz stream over the two-level data plane (np=4 as
