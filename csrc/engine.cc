@@ -716,11 +716,6 @@ void Engine::AbsorbRequest(const Request& req,
     }
     return;
   }
-  if (timeline_.enabled()) {
-    if (req.request_rank == 0)
-      timeline_.NegotiateStart(req.tensor_name, OpName(req.request_type));
-    timeline_.NegotiateRankReady(req.tensor_name, req.request_rank);
-  }
   // Table key: process-set requests are scoped by set id, so the same
   // tensor name may be in flight in two different sets at once.
   std::string key =
@@ -728,6 +723,13 @@ void Engine::AbsorbRequest(const Request& req,
           ? req.tensor_name + "@ps" + std::to_string(req.process_set_id)
           : req.tensor_name;
   auto& ent = msg_table_[key];
+  if (timeline_.enabled()) {
+    // Start on the FIRST request for this key — a process set may not
+    // contain rank 0, and an End without a Start corrupts the trace.
+    if (ent.requests.empty())
+      timeline_.NegotiateStart(req.tensor_name, OpName(req.request_type));
+    timeline_.NegotiateRankReady(req.tensor_name, req.request_rank);
+  }
   if (ent.requests.empty()) ent.first_seen_s = NowS();
   ent.requests.push_back(req);
   // Process-set request: ready when every member is in (join is

@@ -183,12 +183,14 @@ def allreduce(tensor, average: Optional[bool] = None,
 
 def grouped_allreduce(tensors: List, average: Optional[bool] = None,
                       name: Optional[str] = None,
-                      op: Optional[ReduceOp] = None) -> List:
+                      op: Optional[ReduceOp] = None,
+                      process_set=None) -> List:
     """Eager grouped allreduce; entries negotiate individually but fuse in
     the controller exactly like individually-submitted tensors do."""
     op = _resolve_op(op, average)
     base = _auto_name("grouped_allreduce", name)
-    handles = [allreduce_async(t, name=f"{base}.{i}", op=op)
+    handles = [allreduce_async(t, name=f"{base}.{i}", op=op,
+                               process_set=process_set)
                for i, t in enumerate(tensors)]
     return [synchronize(h) for h in handles]
 

@@ -746,10 +746,14 @@ class PyEngine(_EngineBase):
                         if nm not in ready:
                             ready.append(nm)
                 return
-            if self.timeline.enabled and req.request_rank == 0:
-                self.timeline.negotiate_start(
-                    req.tensor_name, _OP_NAMES[req.request_type])
             if self.timeline.enabled:
+                # Start on the FIRST request for this key — a process
+                # set may not contain rank 0, and an End without a
+                # Start corrupts the trace.
+                key = _MessageTable.key_of(req)
+                if key not in self._msg_table.entries:
+                    self.timeline.negotiate_start(
+                        req.tensor_name, _OP_NAMES[req.request_type])
                 self.timeline.negotiate_rank_ready(
                     req.tensor_name, req.request_rank)
             if self._msg_table.increment(req, len(self._joined_ranks)):

@@ -66,12 +66,14 @@ def barrier() -> None:
 
 
 def allreduce_async(tensor, average=None, name=None, op=None,
-                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None) -> int:
     rop = _resolve_op(op, average)
     arr = _to_numpy(tensor)
     h = basics._engine().allreduce_async(
         _auto_name("torch.allreduce", name), arr, op=rop,
-        prescale=prescale_factor, postscale=postscale_factor)
+        prescale=prescale_factor, postscale=postscale_factor,
+        process_set=process_set)
 
     def finalize(result):
         return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
@@ -102,27 +104,32 @@ def allreduce_async_(tensor, average=None, name=None, op=None,
 
 class _HorovodAllreduce(torch.autograd.Function):
     """Parity: mpi_ops.py HorovodAllreduce — the gradient of an
-    allreduce is the same allreduce of the upstream gradient."""
+    allreduce is the same allreduce of the upstream gradient (over the
+    same process set)."""
 
     @staticmethod
-    def forward(ctx, tensor, average, name, op, prescale, postscale):
+    def forward(ctx, tensor, average, name, op, prescale, postscale,
+                process_set=None):
         ctx.average = average
         ctx.op = op
         ctx.prescale = prescale
         ctx.postscale = postscale
+        ctx.process_set = process_set
         return synchronize(allreduce_async(tensor, average, name, op,
-                                           prescale, postscale))
+                                           prescale, postscale,
+                                           process_set))
 
     @staticmethod
     def backward(ctx, grad_output):
         reduced = _HorovodAllreduce.apply(
             grad_output, ctx.average, None, ctx.op, ctx.prescale,
-            ctx.postscale)
-        return reduced, None, None, None, None, None
+            ctx.postscale, ctx.process_set)
+        return reduced, None, None, None, None, None, None
 
 
 def allreduce(tensor, average=None, name=None, compression=None, op=None,
-              prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+              prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None) -> torch.Tensor:
     """Differentiable allreduce returning a new tensor."""
     from horovod_tpu.torch.compression import Compression
 
@@ -130,7 +137,7 @@ def allreduce(tensor, average=None, name=None, compression=None, op=None,
     compressed, ctx = compression.compress(tensor)
     reduced = _HorovodAllreduce.apply(
         compressed, average, _auto_name("torch.allreduce", name), op,
-        prescale_factor, postscale_factor)
+        prescale_factor, postscale_factor, process_set)
     return compression.decompress(reduced, ctx)
 
 
@@ -141,15 +148,18 @@ def allreduce_(tensor, average=None, name=None, op=None,
 
 
 def grouped_allreduce_async(tensors, average=None, name=None,
-                            op=None) -> list:
+                            op=None, process_set=None) -> list:
     base = _auto_name("torch.grouped", name)
-    return [allreduce_async(t, average, f"{base}.{i}", op)
+    return [allreduce_async(t, average, f"{base}.{i}", op,
+                            process_set=process_set)
             for i, t in enumerate(tensors)]
 
 
-def grouped_allreduce(tensors, average=None, name=None, op=None) -> list:
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      process_set=None) -> list:
     return [synchronize(h)
-            for h in grouped_allreduce_async(tensors, average, name, op)]
+            for h in grouped_allreduce_async(tensors, average, name, op,
+                                             process_set)]
 
 
 # ---------------------------------------------------------------------------
@@ -157,10 +167,11 @@ def grouped_allreduce(tensors, average=None, name=None, op=None) -> list:
 # ---------------------------------------------------------------------------
 
 
-def allgather_async(tensor, name=None) -> int:
+def allgather_async(tensor, name=None, process_set=None) -> int:
     arr = _to_numpy(tensor)
     h = basics._engine().allgather_async(
-        _auto_name("torch.allgather", name), arr)
+        _auto_name("torch.allgather", name), arr,
+        process_set=process_set)
     tail_shape = tuple(tensor.shape[1:]) if tensor.dim() > 0 else ()
 
     def finalize(result):
@@ -200,7 +211,8 @@ def allgather(tensor, name=None) -> torch.Tensor:
                                    _auto_name("torch.allgather", name))
 
 
-def reducescatter_async(tensor, average=None, name=None, op=None) -> int:
+def reducescatter_async(tensor, average=None, name=None, op=None,
+                        process_set=None) -> int:
     """Reduce across ranks, scatter over dim 0 (rank r receives the r-th
     near-equal row chunk; the reference project added torch
     ``hvd.reducescatter`` right after the v0.19 line)."""
@@ -213,7 +225,8 @@ def reducescatter_async(tensor, average=None, name=None, op=None) -> int:
             "(got a scalar)")
     arr = _to_numpy(tensor)
     h = basics._engine().reducescatter_async(
-        _auto_name("torch.reducescatter", name), arr, op=rop)
+        _auto_name("torch.reducescatter", name), arr, op=rop,
+        process_set=process_set)
     tail_shape = tuple(tensor.shape[1:])
 
     def finalize(result):
@@ -225,8 +238,10 @@ def reducescatter_async(tensor, average=None, name=None, op=None) -> int:
     return _register(h, finalize)
 
 
-def reducescatter(tensor, average=None, name=None, op=None) -> torch.Tensor:
-    return synchronize(reducescatter_async(tensor, average, name, op))
+def reducescatter(tensor, average=None, name=None, op=None,
+                  process_set=None) -> torch.Tensor:
+    return synchronize(reducescatter_async(tensor, average, name, op,
+                                           process_set))
 
 
 # ---------------------------------------------------------------------------
@@ -234,10 +249,12 @@ def reducescatter(tensor, average=None, name=None, op=None) -> torch.Tensor:
 # ---------------------------------------------------------------------------
 
 
-def broadcast_async(tensor, root_rank, name=None) -> int:
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=None) -> int:
     arr = _to_numpy(tensor)
     h = basics._engine().broadcast_async(
-        _auto_name("torch.broadcast", name), arr, root_rank=root_rank)
+        _auto_name("torch.broadcast", name), arr, root_rank=root_rank,
+        process_set=process_set)
 
     def finalize(result):
         return torch.from_numpy(np.asarray(result)).reshape(tensor.shape) \
@@ -264,25 +281,30 @@ def broadcast_async_(tensor, root_rank, name=None) -> int:
 
 class _HorovodBroadcast(torch.autograd.Function):
     """Parity: mpi_ops.py HorovodBroadcast — backward sums gradients to
-    the root; non-root ranks receive zero."""
+    the root (over the same process set); non-root ranks receive zero."""
 
     @staticmethod
-    def forward(ctx, tensor, root_rank, name):
+    def forward(ctx, tensor, root_rank, name, process_set=None):
         ctx.root_rank = root_rank
-        return synchronize(broadcast_async(tensor, root_rank, name))
+        ctx.process_set = process_set
+        return synchronize(broadcast_async(tensor, root_rank, name,
+                                           process_set))
 
     @staticmethod
     def backward(ctx, grad_output):
         grad_reduced = _HorovodAllreduce.apply(
-            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0)
+            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0,
+            ctx.process_set)
         if basics.rank() != ctx.root_rank:
             grad_reduced = grad_reduced * 0
-        return grad_reduced, None, None
+        return grad_reduced, None, None, None
 
 
-def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
+def broadcast(tensor, root_rank, name=None,
+              process_set=None) -> torch.Tensor:
     return _HorovodBroadcast.apply(tensor, root_rank,
-                                   _auto_name("torch.broadcast", name))
+                                   _auto_name("torch.broadcast", name),
+                                   process_set)
 
 
 def broadcast_(tensor, root_rank, name=None) -> torch.Tensor:

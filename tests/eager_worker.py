@@ -330,6 +330,13 @@ def scenario_process_sets():
             raise AssertionError("expected Adasum/process-set error")
         except RuntimeError as e:
             assert "Adasum is not supported with process sets" in str(e), e
+    # grouped allreduce scoped to a set (fusion stays within the set)
+    my_ep = evens if evens.included() else odds
+    outs = hvd.grouped_allreduce(
+        [np.full(3, float(rank + 1), np.float32) for _ in range(3)],
+        op=hvd.Sum, name="ps.grouped", process_set=my_ep)
+    for out in outs:
+        np.testing.assert_allclose(out, sum(r + 1.0 for r in my_ep.ranks))
     # Set membership makes per-rank op counts asymmetric; sync before the
     # worker's shutdown so no rank tears the mesh down mid-collective.
     hvd.barrier()
