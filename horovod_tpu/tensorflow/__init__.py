@@ -81,18 +81,22 @@ def _engine_call(fn, x, out_dtype):
 
 
 def allreduce(tensor, average=None, device_dense="", device_sparse="",
-              compression=Compression.none, op=None, name=None):
+              compression=Compression.none, op=None, name=None,
+              process_set=None):
     """Differentiable allreduce of a tf.Tensor (or IndexedSlices, which
     gather values+indices like the reference, tensorflow/__init__.py:74)."""
     if isinstance(tensor, tf.IndexedSlices):
-        # Sparse gradient path: allgather values and indices.
+        # Sparse gradient path: allgather values and indices (over the
+        # process set when given — a silently-global gather would
+        # deadlock set members against non-members).
         values = allgather(tensor.values, name=f"{name}.values"
-                           if name else None)
+                           if name else None, process_set=process_set)
         indices = allgather(tensor.indices, name=f"{name}.indices"
-                            if name else None)
+                            if name else None, process_set=process_set)
         rop = _resolve_op(op, average)
         if rop == ReduceOp.AVERAGE:
-            values = values / size()
+            values = values / (process_set.size()
+                               if process_set is not None else size())
         return tf.IndexedSlices(values, indices,
                                 dense_shape=tensor.dense_shape)
     rop = _resolve_op(op, average)
@@ -102,7 +106,9 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
     @tf.custom_gradient
     def _fn(x):
         y = _engine_call(
-            lambda v: _eager.allreduce(v, op=rop, name=nm), x, x.dtype)
+            lambda v: _eager.allreduce(v, op=rop, name=nm,
+                                       process_set=process_set),
+            x, x.dtype)
         # The engine flattens 0-d scalars to shape (1,); restore.
         y = tf.reshape(y, tf.shape(x))
         y.set_shape(x.shape)
@@ -110,14 +116,15 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
         def grad(dy):
             # Derived (trace-time) names keep every rank's runtime naming
             # identical even when TF executes py_functions concurrently.
-            return allreduce(dy, op=rop, name=f"{nm}.grad")
+            return allreduce(dy, op=rop, name=f"{nm}.grad",
+                             process_set=process_set)
 
         return y, grad
 
     return compression.decompress(_fn(compressed), ctx)
 
 
-def allgather(tensor, name=None):
+def allgather(tensor, name=None, process_set=None):
     """Differentiable allgather: concat along dim 0 (ragged first dims
     allowed); backward reduces and extracts this rank's segment."""
     nm = _auto_name("tf.allgather", name)
@@ -126,15 +133,22 @@ def allgather(tensor, name=None):
 
     @tf.custom_gradient
     def _fn(x):
-        y = _engine_call(lambda v: _eager.allgather(v, name=nm), x, x.dtype)
+        y = _engine_call(
+            lambda v: _eager.allgather(v, name=nm,
+                                       process_set=process_set),
+            x, x.dtype)
         y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
 
         def grad(dy):
-            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad",
+                                process_set=process_set)
             sizes = _engine_call(
-                lambda v: _eager.allgather(v, name=f"{nm}.grad.sizes"),
+                lambda v: _eager.allgather(v, name=f"{nm}.grad.sizes",
+                                           process_set=process_set),
                 tf.reshape(dim0, [1]), tf.int32)
-            offset = tf.reduce_sum(sizes[:rank()])
+            my_pos = process_set.rank() if process_set is not None \
+                else rank()
+            offset = tf.reduce_sum(sizes[:my_pos])
             return reduced[offset:offset + dim0]
 
         return y, grad
@@ -142,7 +156,8 @@ def allgather(tensor, name=None):
     return _fn(x)
 
 
-def reducescatter(tensor, average=None, name=None, op=None):
+def reducescatter(tensor, average=None, name=None, op=None,
+                  process_set=None):
     """Differentiable reducescatter: reduce across ranks, scatter over
     dim 0 (rank r receives the r-th near-equal row chunk; the reference
     project added ``hvd.reducescatter`` right after the v0.19 line).
@@ -161,17 +176,20 @@ def reducescatter(tensor, average=None, name=None, op=None):
     @tf.custom_gradient
     def _fn(x):
         y = _engine_call(
-            lambda v: _eager.reducescatter(v, name=nm, op=rop),
+            lambda v: _eager.reducescatter(v, name=nm, op=rop,
+                                           process_set=process_set),
             x, x.dtype)
         y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
 
         def grad(dy):
             g = _engine_call(
-                lambda v: _eager.allgather(v, name=f"{nm}.grad"),
+                lambda v: _eager.allgather(v, name=f"{nm}.grad",
+                                           process_set=process_set),
                 dy, dy.dtype)
             g.set_shape(x.shape)
             if rop == ReduceOp.AVERAGE:
-                g = g / size()
+                g = g / (process_set.size() if process_set is not None
+                         else size())
             return g
 
         return y, grad
@@ -179,21 +197,23 @@ def reducescatter(tensor, average=None, name=None, op=None):
     return _fn(x)
 
 
-def broadcast(tensor, root_rank=0, name=None):
+def broadcast(tensor, root_rank=0, name=None, process_set=None):
     """Differentiable broadcast from root; backward sums to root."""
     nm = _auto_name("tf.broadcast", name)
 
     @tf.custom_gradient
     def _fn(x):
         y = _engine_call(
-            lambda v: _eager.broadcast(v, root_rank=root_rank, name=nm),
+            lambda v: _eager.broadcast(v, root_rank=root_rank, name=nm,
+                                       process_set=process_set),
             x, x.dtype)
         # The engine flattens 0-d scalars to shape (1,); restore.
         y = tf.reshape(y, tf.shape(x))
         y.set_shape(x.shape)
 
         def grad(dy):
-            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad")
+            reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad",
+                                process_set=process_set)
             if rank() == root_rank:
                 return reduced
             return reduced * 0

@@ -83,13 +83,15 @@ def allreduce_async(tensor, average=None, name=None, op=None,
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,
-                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None) -> int:
     """In-place: the reduced values are written back into `tensor`."""
     rop = _resolve_op(op, average)
     arr = _to_numpy(tensor)
     h = basics._engine().allreduce_async(
         _auto_name("torch.allreduce", name), arr, op=rop,
-        prescale=prescale_factor, postscale=postscale_factor)
+        prescale=prescale_factor, postscale=postscale_factor,
+        process_set=process_set)
 
     def finalize(result):
         # copy_ performs the host->device transfer itself; no pre-staging.
@@ -142,9 +144,11 @@ def allreduce(tensor, average=None, name=None, compression=None, op=None,
 
 
 def allreduce_(tensor, average=None, name=None, op=None,
-               prescale_factor=1.0, postscale_factor=1.0) -> torch.Tensor:
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=None) -> torch.Tensor:
     return synchronize(allreduce_async_(tensor, average, name, op,
-                                        prescale_factor, postscale_factor))
+                                        prescale_factor, postscale_factor,
+                                        process_set))
 
 
 def grouped_allreduce_async(tensors, average=None, name=None,
@@ -185,30 +189,34 @@ def allgather_async(tensor, name=None, process_set=None) -> int:
 
 class _HorovodAllgather(torch.autograd.Function):
     """Parity: mpi_ops.py HorovodAllgather — backward allreduces the
-    gradient and narrows to this rank's segment.  First dims may differ
-    per rank, so the true offset comes from gathering the per-rank
-    sizes, like the reference's grad_fn."""
+    gradient (over the same process set) and narrows to this rank's
+    segment.  First dims may differ per rank, so the true offset comes
+    from gathering the per-rank sizes, like the reference's grad_fn."""
 
     @staticmethod
-    def forward(ctx, tensor, name):
+    def forward(ctx, tensor, name, process_set=None):
         ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
-        return synchronize(allgather_async(tensor, name))
+        ctx.process_set = process_set
+        return synchronize(allgather_async(tensor, name, process_set))
 
     @staticmethod
     def backward(ctx, grad_output):
+        ps = ctx.process_set
         grad_reduced = _HorovodAllreduce.apply(
-            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0)
+            grad_output, None, None, ReduceOp.SUM, 1.0, 1.0, ps)
         sizes = synchronize(allgather_async(
-            torch.tensor([ctx.dim0], dtype=torch.int64), None))
-        offset = int(sizes[:basics.rank()].sum())
-        return grad_reduced.narrow(0, offset, ctx.dim0), None
+            torch.tensor([ctx.dim0], dtype=torch.int64), None, ps))
+        my_pos = ps.rank() if ps is not None else basics.rank()
+        offset = int(sizes[:my_pos].sum())
+        return grad_reduced.narrow(0, offset, ctx.dim0), None, None
 
 
-def allgather(tensor, name=None) -> torch.Tensor:
+def allgather(tensor, name=None, process_set=None) -> torch.Tensor:
     """Differentiable allgather: concatenation along dim 0 across ranks
     (first dims may differ per rank)."""
     return _HorovodAllgather.apply(tensor,
-                                   _auto_name("torch.allgather", name))
+                                   _auto_name("torch.allgather", name),
+                                   process_set)
 
 
 def reducescatter_async(tensor, average=None, name=None, op=None,
@@ -263,10 +271,12 @@ def broadcast_async(tensor, root_rank, name=None,
     return _register(h, finalize)
 
 
-def broadcast_async_(tensor, root_rank, name=None) -> int:
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=None) -> int:
     arr = _to_numpy(tensor)
     h = basics._engine().broadcast_async(
-        _auto_name("torch.broadcast", name), arr, root_rank=root_rank)
+        _auto_name("torch.broadcast", name), arr, root_rank=root_rank,
+        process_set=process_set)
 
     def finalize(result):
         # copy_ performs the host->device transfer itself; no pre-staging.
@@ -307,8 +317,10 @@ def broadcast(tensor, root_rank, name=None,
                                    process_set)
 
 
-def broadcast_(tensor, root_rank, name=None) -> torch.Tensor:
-    return synchronize(broadcast_async_(tensor, root_rank, name))
+def broadcast_(tensor, root_rank, name=None,
+               process_set=None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name,
+                                        process_set))
 
 
 # ---------------------------------------------------------------------------

@@ -70,7 +70,7 @@ _SLOW_NODEIDS = (
     "test_torch_binding.py::test_torch_adasum_golden[py]",
     "test_torch_binding.py::test_torch_ops_3proc",
     "test_torch_binding.py::test_torch_join",
-    "test_torch_binding.py::test_torch_optimizer_accumulate",
+    # (optimizer_accumulate now rides the 2-proc torch gang for free)
     "test_launcher_e2e.py::test_cli_four_proc",
     "test_pipeline.py::test_pipeline_forward_matches_dense[4]",
     "test_pipeline.py::test_pipeline_microbatch_count",

@@ -117,62 +117,71 @@ _GANG_SCENARIOS = {
 _gang_cache = {}
 
 
-def _gang_status(np_, engine, profile):
-    key = (np_, engine, profile)
-    if key not in _gang_cache:
-        names = _GANG_SCENARIOS[(np_, profile)]
-        kwargs = {}
-        if profile == "hier":
-            kwargs = {"local_size": 2, "extra_env": _HIER_ENV}
-        status = {}
-        try:
-            outs = run_workers(",".join(names), np_, engine=engine,
-                               **kwargs)
-        except AssertionError as e:
-            outs = getattr(e, "outs", None)
-            if outs is None:  # timeout — no per-scenario attribution
-                status = {n: f"gang did not complete: {e}" for n in names}
-        if not status:
-            for n in names:
-                oks = sum(1 for (_c, out, _e) in outs
-                          if f"SCENARIO_OK {n}" in out)
-                if oks == len(outs):
-                    status[n] = "OK"
-                else:
-                    detail = "\n".join(
-                        f"--- rank {r} (exit {c}) ---\n{out}\n{err}"
-                        for r, (c, out, err) in enumerate(outs))
-                    status[n] = f"FAIL ({oks}/{len(outs)} ranks ok)\n" \
-                        + detail[-6000:]
-        bad_exits = [r for r, (c, _o, _e) in enumerate(outs or [])
-                     if c != 0]
-        if status and all(v == "OK" for v in status.values()) \
-                and not bad_exits:
-            status["__gang__"] = "OK"
-        else:
-            parts = [n for n, v in status.items() if v != "OK"]
-            if bad_exits:
-                # Teardown crashes after the last scenario marker must
-                # not be masked by per-scenario OK counts.
-                parts.append(
-                    "nonzero exit on ranks "
-                    f"{bad_exits}: "
-                    + " | ".join((outs[r][2] or outs[r][1])[-500:]
-                                 for r in bad_exits))
-            status["__gang__"] = "; ".join(parts)
-        _gang_cache[key] = status
-    return _gang_cache[key]
+def run_gang(run_fn, names, **kwargs):
+    """Run a comma-joined scenario batch via ``run_fn`` and parse the
+    per-scenario SCENARIO_OK/FAIL markers into a status dict (shared by
+    the eager and torch gang suites).  ``__gang__`` summarizes the whole
+    gang: teardown crashes after the last marker must not be masked by
+    per-scenario OK counts."""
+    status = {}
+    outs = None
+    try:
+        outs = run_fn(",".join(names), **kwargs)
+    except AssertionError as e:
+        outs = getattr(e, "outs", None)
+        if outs is None:  # timeout — no per-scenario attribution
+            status = {n: f"gang did not complete: {e}" for n in names}
+    if not status:
+        for n in names:
+            oks = sum(1 for (_c, out, _e) in outs
+                      if f"SCENARIO_OK {n}" in out)
+            if oks == len(outs):
+                status[n] = "OK"
+            else:
+                detail = "\n".join(
+                    f"--- rank {r} (exit {c}) ---\n{out}\n{err}"
+                    for r, (c, out, err) in enumerate(outs))
+                status[n] = f"FAIL ({oks}/{len(outs)} ranks ok)\n" \
+                    + detail[-6000:]
+    bad_exits = [r for r, (c, _o, _e) in enumerate(outs or []) if c != 0]
+    if status and all(v == "OK" for v in status.values()) \
+            and not bad_exits:
+        status["__gang__"] = "OK"
+    else:
+        parts = [n for n, v in status.items() if v != "OK"]
+        if bad_exits:
+            parts.append(
+                f"nonzero exit on ranks {bad_exits}: "
+                + " | ".join((outs[r][2] or outs[r][1])[-500:]
+                             for r in bad_exits))
+        status["__gang__"] = "; ".join(parts)
+    return status
 
 
-def assert_gang(scenario, np_, engine, profile="plain"):
-    status = _gang_status(np_, engine, profile)
+def assert_gang_member(status, scenario, gang_desc):
     assert status[scenario] == "OK", status[scenario]
     # Any member failing fails every test of the gang — default runs
     # prune some per-scenario tests, and a batched failure must never
     # hide behind a pruned sibling.
     assert status["__gang__"] == "OK", (
-        f"gang ({np_},{engine},{profile}) had failures in: "
-        f"{status['__gang__']}")
+        f"gang {gang_desc} had failures in: {status['__gang__']}")
+
+
+def _gang_status(np_, engine, profile):
+    key = (np_, engine, profile)
+    if key not in _gang_cache:
+        kwargs = {}
+        if profile == "hier":
+            kwargs = {"local_size": 2, "extra_env": _HIER_ENV}
+        _gang_cache[key] = run_gang(
+            run_workers, _GANG_SCENARIOS[(np_, profile)], np_=np_,
+            engine=engine, **kwargs)
+    return _gang_cache[key]
+
+
+def assert_gang(scenario, np_, engine, profile="plain"):
+    assert_gang_member(_gang_status(np_, engine, profile), scenario,
+                       f"({np_},{engine},{profile})")
 
 
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])

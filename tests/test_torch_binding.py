@@ -58,9 +58,13 @@ def run_torch_workers(scenario, np_=2, timeout=180.0, engine="native"):
                 raise AssertionError(f"torch scenario {scenario} timed out")
             outs.append((p.returncode, out.decode(), err.decode()))
         for rank, (code, out, err) in enumerate(outs):
-            assert code == 0, (
-                f"torch scenario {scenario} rank {rank} failed "
-                f"(exit {code}):\n{out}\n{err}")
+            if code != 0:
+                e = AssertionError(
+                    f"torch scenario {scenario} rank {rank} failed "
+                    f"(exit {code}):\n{out}\n{err}")
+                e.outs = outs  # gang batching parses per-scenario markers
+                raise e
+        return outs
     finally:
         for p in procs:
             if p.poll() is None:
@@ -144,10 +148,26 @@ class TestSingleProcess:
 
 # -- multi-process --------------------------------------------------------
 
+# Gang batching: the benign 2-proc scenarios share one worker gang per
+# engine (marker protocol + status parsing shared with
+# test_multiprocess.run_gang); join/adasum keep isolated gangs below.
+from test_multiprocess import assert_gang_member, run_gang  # noqa: E402
+
+_TORCH_GANG = ("ops", "grads", "optimizer", "optimizer_accumulate")
+_torch_gang_cache = {}
+
+
+def _assert_torch_gang(scenario, engine):
+    if engine not in _torch_gang_cache:
+        _torch_gang_cache[engine] = run_gang(
+            run_torch_workers, _TORCH_GANG, np_=2, engine=engine)
+    assert_gang_member(_torch_gang_cache[engine], scenario,
+                       f"torch ({engine})")
+
 
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 def test_torch_ops(engine):
-    run_torch_workers("ops", 2, engine=engine)
+    _assert_torch_gang("ops", engine)
 
 
 def test_torch_ops_3proc():
@@ -156,16 +176,16 @@ def test_torch_ops_3proc():
 
 @pytest.mark.parametrize("engine", ENGINES)
 def test_torch_grads(engine):
-    run_torch_workers("grads", 2, engine=engine)
+    _assert_torch_gang("grads", engine)
 
 
 @pytest.mark.parametrize("engine", ENGINES)
 def test_torch_optimizer(engine):
-    run_torch_workers("optimizer", 2, engine=engine)
+    _assert_torch_gang("optimizer", engine)
 
 
 def test_torch_optimizer_accumulate():
-    run_torch_workers("optimizer_accumulate", 2)
+    _assert_torch_gang("optimizer_accumulate", "native")
 
 
 def test_torch_join():

@@ -48,6 +48,28 @@ def scenario_ops():
     # broadcast_object
     obj = hvd.broadcast_object({"a": rank} if rank == 0 else None, 0)
     assert obj == {"a": 0}
+    # process sets through the TF surface: per-rank singleton sets
+    # (identity semantics) and a full-membership set with a gradient.
+    # EVERY rank must construct EVERY set (non-members need the registry
+    # to skip a set's responses — process_sets.py contract).
+    import horovod_tpu as hvd_base
+
+    singletons = [hvd_base.ProcessSet([r]) for r in range(size)]
+    mine = singletons[rank]
+    out = hvd.allreduce(tf.ones(3) * (rank + 1), op=hvd.Sum,
+                        name="tf.ps.self", process_set=mine)
+    np.testing.assert_allclose(out.numpy(), np.full(3, rank + 1.0))
+    everyone = hvd_base.ProcessSet(range(size))
+    v = tf.Variable(tf.ones([2]) * (rank + 1))
+    with tf.GradientTape() as tape:
+        y = hvd.allreduce(v, op=hvd.Sum, name="tf.ps.all",
+                          process_set=everyone)
+        loss = tf.reduce_sum(y)
+    np.testing.assert_allclose(
+        y.numpy(), np.full(2, sum(r + 1.0 for r in range(size))))
+    g = tape.gradient(loss, v)
+    np.testing.assert_allclose(g.numpy(), np.full(2, float(size)))
+
     # reducescatter: sum across ranks, rank r keeps row chunk r;
     # differentiable (backward = allgather of the chunk gradients)
     x = tf.Variable(tf.ones([size * 2, 3]) * float(rank + 1))

@@ -230,12 +230,31 @@ SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
 
 
 def main():
-    name = sys.argv[1]
+    # Comma-separated batches run in one engine lifetime with
+    # per-scenario markers (same gang protocol as eager_worker.py).
+    names = sys.argv[1].split(",")
     hvd.init()
+    ok = True
     try:
-        SCENARIOS[name]()
+        for name in names:
+            try:
+                SCENARIOS[name]()
+                print(f"SCENARIO_OK {name}", flush=True)
+            except BaseException:
+                import traceback
+
+                traceback.print_exc()
+                print(f"SCENARIO_FAIL {name}", flush=True)
+                ok = False
+                break
     finally:
-        hvd.shutdown()
+        try:
+            hvd.shutdown()
+        except BaseException:
+            if ok:
+                raise
+    if not ok:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
