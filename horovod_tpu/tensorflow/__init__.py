@@ -248,11 +248,12 @@ def broadcast_object(obj, root_rank=0, name=None):
     return _eager.broadcast_object(obj, root_rank, name)
 
 
-def broadcast_variables(variables, root_rank=0):
+def broadcast_variables(variables, root_rank=0, process_set=None):
     """Assigns every variable the root's value (parity:
     tensorflow/__init__.py:139 broadcast_variables)."""
     for i, v in enumerate(variables):
-        v.assign(broadcast(v, root_rank, name=f"bv.{i}"))
+        v.assign(broadcast(v, root_rank, name=f"bv.{i}",
+                           process_set=process_set))
 
 
 def BroadcastGlobalVariablesHook(root_rank=0, device=""):
@@ -277,12 +278,14 @@ class DistributedGradientTape:
 
     def __init__(self, gradtape=None, device_dense="", device_sparse="",
                  compression=Compression.none, op=ReduceOp.AVERAGE,
-                 persistent=False, watch_accessed_variables=True):
+                 persistent=False, watch_accessed_variables=True,
+                 process_set=None):
         self._tape = gradtape if gradtape is not None else tf.GradientTape(
             persistent=persistent,
             watch_accessed_variables=watch_accessed_variables)
         self._compression = compression
         self._op = op
+        self._process_set = process_set
 
     def __enter__(self):
         self._tape.__enter__()
@@ -302,7 +305,9 @@ class DistributedGradientTape:
             grads = [grads]
         reduced = [
             allreduce(g, op=self._op, compression=self._compression,
-                      name=f"dgt.{i}") if g is not None else None
+                      name=f"dgt.{i}",
+                      process_set=self._process_set)
+            if g is not None else None
             for i, g in enumerate(grads)]
         return reduced[0] if single else reduced
 

@@ -78,11 +78,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     synchronization barrier)."""
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1, op=ReduceOp.AVERAGE):
+                 backward_passes_per_step=1, op=ReduceOp.AVERAGE,
+                 process_set=None):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.op = op
         self.backward_passes_per_step = backward_passes_per_step
+        self.process_set = process_set
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -110,7 +112,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._synchronized = False
         self._should_synchronize = True
         self._allreduce_delay = {}
-        if size() > 1:
+        active = size() > 1 if self.process_set is None \
+            else self.process_set.size() > 1
+        if self.process_set is not None and \
+                not self.process_set.included():
+            raise ValueError(
+                f"rank {rank()} is not a member of {self.process_set}; "
+                "construct the optimizer only on member ranks")
+        if active:
             self._register_hooks()
 
     # -- hooks ------------------------------------------------------------
@@ -147,7 +156,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         compressed, ctx = self._compression.compress(p.grad)
         self._ctxs[p] = ctx
         return allreduce_async(compressed, name=f"allreduce.{name}",
-                               op=self.op)
+                               op=self.op,
+                               process_set=self.process_set)
 
     # -- synchronization --------------------------------------------------
 
@@ -264,12 +274,16 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
-                         op=ReduceOp.AVERAGE):
+                         op=ReduceOp.AVERAGE, process_set=None):
     """Wraps a torch optimizer: gradient allreduce overlaps backward;
     ``step()`` synchronizes (parity: torch/__init__.py:394-449, same
     dynamic-subclass technique).  ``op=Adasum`` selects the delta-model
-    wrapper (parity: the op switch in the reference factory)."""
+    wrapper (parity: the op switch in the reference factory).
+    ``process_set`` scopes the gradient collectives to a subgroup
+    (construct the optimizer on member ranks only)."""
     if op == ReduceOp.ADASUM:
+        if process_set is not None:
+            raise ValueError("Adasum does not support process sets")
         cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                    dict(_DistributedAdasumOptimizer.__dict__))
         return cls(optimizer.param_groups, named_parameters, compression,
@@ -277,7 +291,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, op)
+               backward_passes_per_step, op, process_set)
 
 
 # ---------------------------------------------------------------------------

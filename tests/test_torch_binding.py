@@ -192,6 +192,11 @@ def test_torch_join():
     run_torch_workers("join", 3)
 
 
+def test_torch_optimizer_process_set():
+    """Hook-driven optimizer scoped to a subgroup at 3 ranks."""
+    run_torch_workers("optimizer_process_set", 3)
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_torch_adasum_golden(engine):
     run_torch_workers("adasum", 4, engine=engine)

@@ -147,6 +147,58 @@ def scenario_optimizer():
         assert torch.allclose(gathered[r], flat, atol=1e-6), "params diverged"
 
 
+def scenario_optimizer_process_set():
+    """DistributedOptimizer scoped to a subgroup: ranks {0, 1} train
+    together (averaged grads, identical params), the last rank trains
+    alone; construct ALL sets on every rank (registry contract)."""
+    import horovod_tpu as hvd_base
+
+    rank, size = hvd.rank(), hvd.size()
+    assert size >= 3
+    pair = hvd_base.ProcessSet([0, 1])
+    loner = hvd_base.ProcessSet([size - 1])
+    torch.manual_seed(1234)
+    model = torch.nn.Linear(6, 1)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    mine = pair if rank in pair.ranks else (
+        loner if rank == size - 1 else None)
+    if mine is not None:
+        opt = hvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            process_set=mine)
+    # One exact step: the pair's update must equal SGD on (g0+g1)/2 —
+    # an identical-but-wrongly-divided average (e.g. /world_size) would
+    # still leave the pair in agreement, so pin the math, not just the
+    # agreement.
+    init_flat = torch.cat(
+        [p.detach().clone().reshape(-1) for p in model.parameters()])
+    rng = np.random.RandomState(100 + rank)
+    x = torch.from_numpy(rng.randn(8, 6).astype(np.float32))
+    opt.zero_grad()
+    ((model(x)) ** 2).mean().backward()
+    opt.step()
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    if rank in pair.ranks:
+        got = hvd.allgather(flat.reshape(1, -1), name="pset.check",
+                            process_set=pair)
+        assert torch.allclose(got[0], got[1], atol=1e-6), "pair diverged"
+        # oracle: recompute both members' local gradients from the same
+        # seeds on an identical fresh model
+        grads = []
+        for r in pair.ranks:
+            torch.manual_seed(1234)
+            m2 = torch.nn.Linear(6, 1)
+            xr = torch.from_numpy(
+                np.random.RandomState(100 + r).randn(8, 6)
+                .astype(np.float32))
+            ((m2(xr)) ** 2).mean().backward()
+            grads.append(torch.cat(
+                [p.grad.reshape(-1) for p in m2.parameters()]))
+        expect = init_flat - 0.1 * (grads[0] + grads[1]) / 2
+        assert torch.allclose(flat, expect, atol=1e-5), (flat, expect)
+    hvd.barrier()
+
+
 def scenario_optimizer_accumulate():
     rank, size = hvd.rank(), hvd.size()
     torch.manual_seed(7)
