@@ -82,7 +82,8 @@ def broadcast(value, root_rank=0, name=None):
 
 def DistributedOptimizer(optimizer, name=None,
                          device_dense="", device_sparse="",
-                         compression=None, op=ReduceOp.AVERAGE):
+                         compression=None, op=ReduceOp.AVERAGE,
+                         process_set=None):
     """Wraps a Keras optimizer so gradients are allreduced across ranks
     before being applied (parity: _keras/__init__.py:20-86 — dynamic
     subclass overriding the gradient-aggregation step).
@@ -104,7 +105,8 @@ def DistributedOptimizer(optimizer, name=None,
     hvd_tf = _tf_surface()
     comp = compression or hvd_tf.Compression.none
     return hvd_tf.DistributedOptimizer(optimizer, name=name,
-                                       compression=comp, op=op)
+                                       compression=comp, op=op,
+                                       process_set=process_set)
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,

@@ -315,7 +315,8 @@ class DistributedGradientTape:
 def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none,
                          op=ReduceOp.AVERAGE,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         process_set=None):
     """Wraps a Keras-3 optimizer: gradients are allreduced before being
     applied (parity: tensorflow/__init__.py:266-311 — there via
     compute_gradients; Keras 3 funnels through apply_gradients).
@@ -339,8 +340,11 @@ def DistributedOptimizer(optimizer, name=None,
     base_cls = optimizer.__class__
     _op = op
     _compression = compression
+    _ps = process_set
 
     if op == ReduceOp.ADASUM:
+        if process_set is not None:
+            raise ValueError("Adasum does not support process sets")
         class _WrappedAdasum(base_cls):
             def apply_gradients(self, grads_and_vars, *args, **kwargs):
                 gv = list(grads_and_vars)
@@ -366,7 +370,8 @@ def DistributedOptimizer(optimizer, name=None,
             tvars = [v for _, v in grads_and_vars]
             reduced = [
                 allreduce(g, op=_op, compression=_compression,
-                          name=f"do.{i}") if g is not None else None
+                          name=f"do.{i}", process_set=_ps)
+                if g is not None else None
                 for i, g in enumerate(grads)]
             return super().apply_gradients(
                 zip(reduced, tvars), *args, **kwargs)

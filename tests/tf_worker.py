@@ -70,6 +70,20 @@ def scenario_ops():
     g = tape.gradient(loss, v)
     np.testing.assert_allclose(g.numpy(), np.full(2, float(size)))
 
+    # DistributedOptimizer scoped to the full-membership set: gradients
+    # ride the set path (a regression to global collectives would change
+    # nothing here numerically, but a broken set path errors/deadlocks —
+    # and the exact value pins the averaged-grad apply).
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5),
+        process_set=everyone)
+    w = tf.Variable(tf.ones([2]) * (rank + 1))
+    opt.apply_gradients([(tf.ones([2]) * (rank + 1), w)])
+    avg_g = sum(r + 1.0 for r in range(size)) / size
+    np.testing.assert_allclose(w.numpy(),
+                               np.full(2, rank + 1.0 - 0.5 * avg_g),
+                               rtol=1e-6)
+
     # reducescatter: sum across ranks, rank r keeps row chunk r;
     # differentiable (backward = allgather of the chunk gradients)
     x = tf.Variable(tf.ones([size * 2, 3]) * float(rank + 1))
