@@ -362,10 +362,12 @@ def scenario_random_ops():
                 .reshape(shape) * (r + 1) + salt).astype(dtype)
 
     n_slots = 12
+    evens = hvd.ProcessSet(range(0, size, 2))
     slots = []
     for _ in range(n_slots):
         kind = str(seq.choice(["allreduce", "allgather", "broadcast",
-                               "reducescatter", "grouped"]))
+                               "reducescatter", "grouped",
+                               "ps_allreduce"]))
         dtype = seq.choice([np.float32, np.float64, np.int32])
         shape = tuple(int(d) for d in
                       seq.randint(1, 5, size=seq.randint(1, 3)))
@@ -388,7 +390,22 @@ def scenario_random_ops():
             # cache-hit path for allreduce slots
         kind, dtype, shape, aux = slots[s]
         name = f"fuzz.{s}"
-        if kind == "allreduce":
+        if kind == "ps_allreduce":
+            # Subgroup traffic interleaved with global ops: EVERY rank
+            # draws the slot and the settle coin below (the shared
+            # stream must stay in sync); only members enqueue, and the
+            # coordinator waits for exactly the members.
+            if evens.included():
+                x = rank_input(i, shape, dtype, rank)
+                oracle = sum(rank_input(i, shape, np.float64, g)
+                             for g in evens.ranks).astype(dtype)
+                outstanding[s] = (hvd.allreduce_async(
+                    x, op=hvd.Sum, name=name, process_set=evens),
+                    oracle, name)
+            if seq.rand() < 0.5 and s in outstanding:
+                settle(s)
+            continue
+        elif kind == "allreduce":
             x = rank_input(i, shape, dtype, rank)
             oracle = sum(rank_input(i, shape, np.float64, r)
                          for r in range(size)).astype(dtype)

@@ -70,19 +70,24 @@ def scenario_ops():
     g = tape.gradient(loss, v)
     np.testing.assert_allclose(g.numpy(), np.full(2, float(size)))
 
-    # DistributedOptimizer scoped to the full-membership set: gradients
-    # ride the set path (a regression to global collectives would change
-    # nothing here numerically, but a broken set path errors/deadlocks —
-    # and the exact value pins the averaged-grad apply).
-    opt = hvd.DistributedOptimizer(
-        tf.keras.optimizers.SGD(learning_rate=0.5),
-        process_set=everyone)
+    # DistributedOptimizer scoped to a PROPER subgroup — each rank's own
+    # singleton set, with per-rank gradient values and same optimizer op
+    # names ("do.0") in different sets concurrently.  If process_set
+    # were silently dropped, both ranks' "do.0" would collide in one
+    # GLOBAL allreduce and average the differing gradients, failing the
+    # exact per-rank oracle below.  Rank 0 goes through the Keras
+    # surface to cover its forwarding.
+    import horovod_tpu.keras as hvd_keras
+
+    factory = (hvd_keras.DistributedOptimizer if rank == 0
+               else hvd.DistributedOptimizer)
+    opt = factory(tf.keras.optimizers.SGD(learning_rate=0.5),
+                  process_set=mine)
     w = tf.Variable(tf.ones([2]) * (rank + 1))
     opt.apply_gradients([(tf.ones([2]) * (rank + 1), w)])
-    avg_g = sum(r + 1.0 for r in range(size)) / size
-    np.testing.assert_allclose(w.numpy(),
-                               np.full(2, rank + 1.0 - 0.5 * avg_g),
-                               rtol=1e-6)
+    np.testing.assert_allclose(
+        w.numpy(), np.full(2, (rank + 1.0) - 0.5 * (rank + 1.0)),
+        rtol=1e-6)
 
     # reducescatter: sum across ranks, rank r keeps row chunk r;
     # differentiable (backward = allgather of the chunk gradients)
