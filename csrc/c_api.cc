@@ -257,13 +257,13 @@ void hvd_release(int64_t handle) {
   if (g_engine) g_engine->handles().Release(handle);
 }
 
-int hvd_barrier() {
+int hvd_barrier(int ps_id, int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
   }
   std::string err;
-  int rc = g_engine->Barrier(&err);
+  int rc = g_engine->Barrier(&err, ps_id, ps_size);
   if (rc != 0) g_last_error = err;
   return rc;
 }

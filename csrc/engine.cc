@@ -486,9 +486,20 @@ int64_t Engine::EnqueueReduceScatter(const std::string& name,
   return Enqueue(std::move(e), err);
 }
 
-int Engine::Barrier(std::string* err) {
+int Engine::Barrier(std::string* err, int32_t ps_id, int32_t ps_size) {
   TensorTableEntry e;
-  e.name = "__barrier." + std::to_string(barrier_counter_.fetch_add(1));
+  int64_t c;
+  if (ps_id == 0) {
+    c = barrier_counter_.fetch_add(1);
+    e.name = "__barrier." + std::to_string(c);
+  } else {
+    // Per-set counters; distinct name families keep a concurrent
+    // global barrier from colliding in the duplicate-name guard.
+    std::lock_guard<std::mutex> lk(process_sets_mu_);
+    c = ps_barrier_counters_[ps_id]++;
+    e.name = "__barrier.ps" + std::to_string(ps_id) + "." +
+             std::to_string(c);
+  }
   static int32_t zero = 0;
   e.data = reinterpret_cast<uint8_t*>(&zero);
   e.nelems = 1;
@@ -497,6 +508,8 @@ int Engine::Barrier(std::string* err) {
   e.request.request_type = RequestType::BARRIER;
   e.request.tensor_name = e.name;
   e.request.tensor_type = DataType::INT32;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   int64_t h = Enqueue(std::move(e), err);
   if (h < 0) return -1;
   StatusType st = handles_.Wait(h);
@@ -950,8 +963,7 @@ Response Engine::ConstructResponse(const std::string& name,
     err = "Mismatched process sets for tensor " + name;
   } else if (first.process_set_id &&
              (first.request_type == RequestType::ALLTOALL ||
-              first.request_type == RequestType::JOIN ||
-              first.request_type == RequestType::BARRIER)) {
+              first.request_type == RequestType::JOIN)) {
     err = std::string(OpName(first.request_type)) +
           " does not support process sets (tensor " + name + ")";
   } else if (first.process_set_id &&
@@ -1258,7 +1270,7 @@ void Engine::PerformResponse(const Response& resp, bool from_cache) {
         DoReduceScatter(entries, resp);
         break;
       case ResponseType::BARRIER:
-        DoBarrier();
+        DoBarrier(resp);
         for (auto& e : entries) {
           ReleaseName(e.name);
           if (e.handle >= 0) handles_.MarkDone(e.handle, Status::OK());
@@ -1762,10 +1774,11 @@ void Engine::DoReduceScatter(std::vector<TensorTableEntry>& entries,
   }
 }
 
-void Engine::DoBarrier() {
+void Engine::DoBarrier(const Response& resp) {
   int32_t zero = 0;
-  RingAllreduceFlat(reinterpret_cast<uint8_t*>(&zero), 1, DataType::INT32,
-                    ReduceOp::SUM);
+  auto [group, me] = ResponseGroup(resp);
+  RingAllreduceGroup(reinterpret_cast<uint8_t*>(&zero), 1,
+                     DataType::INT32, ReduceOp::SUM, group, me);
 }
 
 void Engine::Abort(const std::string& reason) {

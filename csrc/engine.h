@@ -193,7 +193,8 @@ class Engine {
                                ReduceOp op, std::string* err,
                                int32_t ps_id = 0, int32_t ps_size = 0);
 
-  int Barrier(std::string* err);  // blocking; 0 ok
+  int Barrier(std::string* err, int32_t ps_id = 0,
+              int32_t ps_size = 0);  // blocking; 0 ok
   int Join();                     // blocking; returns last joined rank
 
   // Process sets: register member ranks for a set id (idempotent; the
@@ -251,7 +252,7 @@ class Engine {
                   const Response& resp);
   void DoReduceScatter(std::vector<TensorTableEntry>& entries,
                        const Response& resp);
-  void DoBarrier();
+  void DoBarrier(const Response& resp);
 
   // Data plane.
   void RingAllreduceFlat(uint8_t* buf, int64_t nelems, DataType dt,
@@ -319,6 +320,7 @@ class Engine {
   std::atomic<int64_t> barrier_counter_{0};
   std::mutex process_sets_mu_;
   std::map<int32_t, std::vector<int>> process_sets_;
+  std::map<int32_t, int64_t> ps_barrier_counters_;
   std::thread bg_;
 };
 

@@ -135,6 +135,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvd_result_splits.restype = c.c_int
     lib.hvd_release.argtypes = [c.c_int64]
     lib.hvd_release.restype = None
+    lib.hvd_barrier.argtypes = [c.c_int, c.c_int]
     lib.hvd_barrier.restype = c.c_int
     lib.hvd_join.restype = c.c_int
     return lib

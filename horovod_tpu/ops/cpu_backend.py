@@ -533,5 +533,9 @@ def alltoall(engine, entries, resp: Response):
     return results
 
 
-def barrier(engine) -> None:
-    ring_allreduce_flat(engine, np.zeros(1, np.int32), ReduceOp.SUM)
+def barrier(engine, resp: Response) -> None:
+    # Unconditional group walk, mirroring csrc Engine::DoBarrier —
+    # resp_group returns the full world for the global set.
+    group, me = resp_group(engine, resp)
+    _ring_allreduce_group(engine, np.zeros(1, np.int32), ReduceOp.SUM,
+                          group, me)

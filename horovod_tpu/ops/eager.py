@@ -301,8 +301,8 @@ def alltoall(tensor, splits=None, name: Optional[str] = None):
     return synchronize(alltoall_async(tensor, splits, name))
 
 
-def barrier() -> None:
-    basics._engine().barrier()
+def barrier(process_set=None) -> None:
+    basics._engine().barrier(process_set=process_set)
 
 
 def join() -> int:

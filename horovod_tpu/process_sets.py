@@ -20,8 +20,9 @@ Design (TPU-first redesign, not a port):
 * The data plane reuses the full TCP mesh — subgroup rings walk the
   member list in sorted order over the existing peer sockets, with the
   same chunk math as the global ring (mixed native/py bit-compatible).
-* ``join``/``barrier``/``alltoall`` stay global-set-only; the in-graph
-  regime expresses subgroups as mesh axes instead (docs/parallelism.md).
+* ``barrier(process_set=...)`` synchronizes just the members;
+  ``join``/``alltoall`` stay global-set-only.  The in-graph regime
+  expresses subgroups as mesh axes instead (docs/parallelism.md).
 """
 
 from __future__ import annotations

@@ -306,8 +306,9 @@ class NativeEngine:
 
     # -- blocking ops ------------------------------------------------------
 
-    def barrier(self):
-        rc = self._lib.hvd_barrier()
+    def barrier(self, process_set=None):
+        ps_id, ps_size = self._ps_args(process_set)
+        rc = self._lib.hvd_barrier(ps_id, ps_size)
         if rc != 0:
             raise RuntimeError(self._lib.hvd_last_error().decode())
 

@@ -188,7 +188,7 @@ class _EngineBase:
         self.handles = HandleManager()
         self._pending_names: set = set()
         self._name_lock = threading.Lock()
-        self._barrier_counter = 0
+        self._barrier_counters = {0: 0}  # per process-set id
 
     # -- duplicate-name guard (parity: tensor_queue.cc:27-35) -------------
 
@@ -274,7 +274,8 @@ class SingleProcessEngine(_EngineBase):
     def alltoall_async(self, name, array, splits=None):
         return self._finish(name, "ALLTOALL", np.asarray(array).copy())
 
-    def barrier(self):
+    def barrier(self, process_set=None):
+        self._check_ps(process_set)
         return None
 
     def join(self) -> int:
@@ -521,18 +522,25 @@ class PyEngine(_EngineBase):
         entry = TensorTableEntry(name, arr, h, req, splits=splits)
         return self._enqueue(entry)
 
-    def barrier(self):
-        # Dedicated per-engine barrier counter (NOT the handle counter):
-        # the name must be identical on every rank regardless of how many
-        # other ops each rank has issued, and wire-compatible with the
-        # native engine's naming (csrc/engine.cc Engine::Barrier).
+    def barrier(self, process_set=None):
+        # Dedicated per-engine barrier counters (NOT the handle counter,
+        # and one per process set): the name must be identical on every
+        # member regardless of how many other ops each rank has issued,
+        # and wire-compatible with the native engine's naming
+        # (csrc/engine.cc Engine::Barrier).
+        ps_id, ps_size = self._ps_fields(process_set)
         with self._queue_lock:
-            name = f"__barrier.{self._barrier_counter}"
-            self._barrier_counter += 1
+            c = self._barrier_counters.get(ps_id, 0)
+            self._barrier_counters[ps_id] = c + 1
+        # Distinct name families keep a concurrent global barrier and a
+        # set barrier from colliding in the local duplicate-name guard.
+        name = f"__barrier.{c}" if not ps_id else \
+            f"__barrier.ps{ps_id}.{c}"
         req = Request(request_rank=self.rank,
                       request_type=RequestType.BARRIER,
                       tensor_type=DataType.INT32,
-                      tensor_name=name, device="cpu")
+                      tensor_name=name, device="cpu",
+                      process_set_id=ps_id, process_set_size=ps_size)
         h = self.handles.allocate()
         self._enqueue(TensorTableEntry(
             name, np.zeros(1, np.int32), h, req))
@@ -912,8 +920,7 @@ class PyEngine(_EngineBase):
                  for r in reqs):
             err = f"Mismatched process sets for tensor {name}"
         elif first.process_set_id and first.request_type in (
-                RequestType.ALLTOALL, RequestType.JOIN,
-                RequestType.BARRIER):
+                RequestType.ALLTOALL, RequestType.JOIN):
             err = (f"{_OP_NAMES[first.request_type]} does not support "
                    f"process sets (tensor {name})")
         elif any(r.tensor_type != first.tensor_type for r in reqs):
@@ -1149,7 +1156,7 @@ class PyEngine(_EngineBase):
             elif resp.response_type == ResponseType.REDUCESCATTER:
                 results = cpu_backend.reducescatter(self, entries, resp)
             elif resp.response_type == ResponseType.BARRIER:
-                cpu_backend.barrier(self)
+                cpu_backend.barrier(self, resp)
                 results = [None] * len(entries)
             else:
                 raise RuntimeError(f"bad response type {resp.response_type}")

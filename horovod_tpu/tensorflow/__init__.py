@@ -240,8 +240,8 @@ def join():
     return basics._engine().join()
 
 
-def barrier():
-    basics._engine().barrier()
+def barrier(process_set=None):
+    basics._engine().barrier(process_set=process_set)
 
 
 def broadcast_object(obj, root_rank=0, name=None):

@@ -56,8 +56,8 @@ def join() -> int:
     return basics._engine().join()
 
 
-def barrier() -> None:
-    basics._engine().barrier()
+def barrier(process_set=None) -> None:
+    basics._engine().barrier(process_set=process_set)
 
 
 # ---------------------------------------------------------------------------

@@ -337,6 +337,10 @@ def scenario_process_sets():
         op=hvd.Sum, name="ps.grouped", process_set=my_ep)
     for out in outs:
         np.testing.assert_allclose(out, sum(r + 1.0 for r in my_ep.ranks))
+    # set-scoped barrier: only the members synchronize (the coordinator
+    # waits for exactly the members, so this returning at all on every
+    # member — while the other set runs its own — is the assertion)
+    hvd.barrier(process_set=my_ep)
     # Set membership makes per-rank op counts asymmetric; sync before the
     # worker's shutdown so no rank tears the mesh down mid-collective.
     hvd.barrier()

@@ -88,6 +88,15 @@ def scenario_ops():
     np.testing.assert_allclose(
         w.numpy(), np.full(2, (rank + 1.0) - 0.5 * (rank + 1.0)),
         rtol=1e-6)
+    # ...and a MULTI-member set through the optimizer, so the subgroup
+    # ring itself (not just the routing) is on the tested path
+    opt2 = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5), process_set=everyone)
+    w2 = tf.Variable(tf.zeros([2]))
+    opt2.apply_gradients([(tf.ones([2]) * (rank + 1), w2)])
+    avg_g = sum(r + 1.0 for r in range(size)) / size
+    np.testing.assert_allclose(w2.numpy(), np.full(2, -0.5 * avg_g),
+                               rtol=1e-6)
 
     # reducescatter: sum across ranks, rank r keeps row chunk r;
     # differentiable (backward = allgather of the chunk gradients)
