@@ -195,7 +195,8 @@ int64_t hvd_broadcast_async(const char* name, void* buf, int ndim,
 
 int64_t hvd_alltoall_async(const char* name, const void* buf, int ndim,
                            const int64_t* dims, int dtype,
-                           const int64_t* splits, int nsplits) {
+                           const int64_t* splits, int nsplits, int ps_id,
+                           int ps_size) {
   if (!g_engine) {
     g_last_error = "engine not initialized";
     return -1;
@@ -205,7 +206,7 @@ int64_t hvd_alltoall_async(const char* name, const void* buf, int ndim,
   std::string err;
   int64_t h = g_engine->EnqueueAlltoall(name, buf, MakeShape(ndim, dims),
                                         static_cast<hvd::DataType>(dtype),
-                                        sp, &err);
+                                        sp, &err, ps_id, ps_size);
   if (h < 0) g_last_error = err;
   return h;
 }

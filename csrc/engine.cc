@@ -408,17 +408,28 @@ int64_t Engine::EnqueueBroadcast(const std::string& name, void* buf,
 int64_t Engine::EnqueueAlltoall(const std::string& name, const void* buf,
                                 const TensorShape& shape, DataType dt,
                                 const std::vector<int64_t>& splits,
-                                std::string* err) {
+                                std::string* err, int32_t ps_id,
+                                int32_t ps_size) {
+  int n = ps_id ? ps_size : cfg_.size;
+  if (n <= 0) {
+    *err = "alltoall: invalid process_set_size " + std::to_string(ps_size);
+    return -1;
+  }
   if (!splits.empty()) {
+    if (static_cast<int>(splits.size()) != n) {
+      *err = "alltoall needs one split per participant (" +
+             std::to_string(n) + ")";
+      return -1;
+    }
     int64_t total = 0;
     for (auto s : splits) total += s;
     if (shape.dims.empty() || total != shape.dims[0]) {
       *err = "splits must sum to dim 0";
       return -1;
     }
-  } else if (!shape.dims.empty() && shape.dims[0] % cfg_.size != 0) {
-    *err = "alltoall without splits requires dim 0 divisible by the world "
-           "size";
+  } else if (!shape.dims.empty() && shape.dims[0] % n != 0) {
+    *err = "alltoall without splits requires dim 0 divisible by the "
+           "participant count";
     return -1;
   }
   TensorTableEntry e;
@@ -432,6 +443,8 @@ int64_t Engine::EnqueueAlltoall(const std::string& name, const void* buf,
   e.request.tensor_type = dt;
   e.request.tensor_name = name;
   e.request.tensor_shape = shape;
+  e.request.process_set_id = ps_id;
+  e.request.process_set_size = ps_size;
   return Enqueue(std::move(e), err);
 }
 
@@ -962,8 +975,7 @@ Response Engine::ConstructResponse(const std::string& name,
              })) {
     err = "Mismatched process sets for tensor " + name;
   } else if (first.process_set_id &&
-             (first.request_type == RequestType::ALLTOALL ||
-              first.request_type == RequestType::JOIN)) {
+             first.request_type == RequestType::JOIN) {
     err = std::string(OpName(first.request_type)) +
           " does not support process sets (tensor " + name + ")";
   } else if (first.process_set_id &&
@@ -1670,8 +1682,10 @@ void Engine::DoBroadcast(std::vector<TensorTableEntry>& entries,
 
 void Engine::DoAlltoall(std::vector<TensorTableEntry>& entries,
                         const Response& resp) {
-  // Pairwise exchange rounds (parity: cpu_backend.alltoall).
-  int size = cfg_.size, rank = cfg_.rank;
+  // Pairwise exchange rounds (parity: cpu_backend.alltoall); for a
+  // process set, partners walk the member list.
+  auto [group, me] = ResponseGroup(resp);
+  int size = static_cast<int>(group.size()), rank = me;
   for (auto& e : entries) {
     size_t isz = ItemSize(resp.tensor_type);
     int64_t dim0 = e.request.tensor_shape.dims.empty()
@@ -1697,8 +1711,8 @@ void Engine::DoAlltoall(std::vector<TensorTableEntry>& entries,
       int dst = Mod(rank + step, size);
       int src = Mod(rank - step, size);
       std::vector<uint8_t> incoming;
-      Exchange(data_fds_[dst], e.data + offs[dst] * row_bytes,
-               splits[dst] * row_bytes, data_fds_[src], &incoming);
+      Exchange(data_fds_[group[dst]], e.data + offs[dst] * row_bytes,
+               splits[dst] * row_bytes, data_fds_[group[src]], &incoming);
       recv_rows[src] =
           row_bytes ? static_cast<int64_t>(incoming.size() / row_bytes) : 0;
       recv_blocks[src] = std::move(incoming);

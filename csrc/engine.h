@@ -187,7 +187,8 @@ class Engine {
   int64_t EnqueueAlltoall(const std::string& name, const void* buf,
                           const TensorShape& shape, DataType dt,
                           const std::vector<int64_t>& splits,
-                          std::string* err);
+                          std::string* err, int32_t ps_id = 0,
+                          int32_t ps_size = 0);
   int64_t EnqueueReduceScatter(const std::string& name, const void* buf,
                                const TensorShape& shape, DataType dt,
                                ReduceOp op, std::string* err,

@@ -108,7 +108,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hvd_broadcast_async.restype = c.c_int64
     lib.hvd_alltoall_async.argtypes = [
         c.c_char_p, c.c_void_p, c.c_int, c.POINTER(c.c_int64), c.c_int,
-        c.POINTER(c.c_int64), c.c_int,
+        c.POINTER(c.c_int64), c.c_int, c.c_int, c.c_int,
     ]
     lib.hvd_alltoall_async.restype = c.c_int64
     lib.hvd_reducescatter_async.argtypes = [

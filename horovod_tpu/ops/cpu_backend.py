@@ -498,7 +498,10 @@ def broadcast(engine, entries, resp: Response):
 
 
 def alltoall(engine, entries, resp: Response):
-    size, rank = engine.size, engine.rank
+    # Pairwise exchange rounds; for a process set, partners walk the
+    # member list (parity with csrc Engine::DoAlltoall).
+    group, rank = resp_group(engine, resp)
+    size = len(group)
     results = []
     for e in entries:
         splits = e.splits
@@ -506,7 +509,7 @@ def alltoall(engine, entries, resp: Response):
             if e.array.shape[0] % size:
                 raise ValueError(
                     "alltoall without splits requires dim 0 divisible by "
-                    "the world size")
+                    "the participant count")
             per = e.array.shape[0] // size
             splits = [per] * size
         offs = np.concatenate([[0], np.cumsum(splits)])
@@ -519,8 +522,9 @@ def alltoall(engine, entries, resp: Response):
         for step in range(1, size):
             dst = (rank + step) % size
             src = (rank - step) % size
-            t = _send_async(engine._data[dst], my_blocks[dst].tobytes())
-            payload = _recv(engine._data[src])
+            t = _send_async(engine._data[group[dst]],
+                            my_blocks[dst].tobytes())
+            payload = _recv(engine._data[group[src]])
             t.join()
             blk = np.frombuffer(payload, dtype=dtype)
             if rest_shape:

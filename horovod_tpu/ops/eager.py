@@ -281,12 +281,14 @@ def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
                                        process_set))
 
 
-def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
+def alltoall_async(tensor, splits=None, name: Optional[str] = None,
+                   process_set=None) -> int:
     arr, restore = _to_numpy(tensor)
     if splits is not None:
         splits = list(np.asarray(splits).astype(int))
     h = basics._engine().alltoall_async(
-        _auto_name("alltoall", name), arr, splits=splits)
+        _auto_name("alltoall", name), arr, splits=splits,
+        process_set=process_set)
 
     def post(raw):
         if isinstance(raw, tuple):
@@ -297,8 +299,9 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None) -> int:
     return _register(h, post)
 
 
-def alltoall(tensor, splits=None, name: Optional[str] = None):
-    return synchronize(alltoall_async(tensor, splits, name))
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 
 def barrier(process_set=None) -> None:

@@ -20,9 +20,11 @@ Design (TPU-first redesign, not a port):
 * The data plane reuses the full TCP mesh — subgroup rings walk the
   member list in sorted order over the existing peer sockets, with the
   same chunk math as the global ring (mixed native/py bit-compatible).
-* ``barrier(process_set=...)`` synchronizes just the members;
-  ``join``/``alltoall`` stay global-set-only.  The in-graph regime
-  expresses subgroups as mesh axes instead (docs/parallelism.md).
+* Every data op takes ``process_set=`` (allreduce/grouped/allgather/
+  broadcast/reducescatter/alltoall) and ``barrier(process_set=...)``
+  synchronizes just the members; only ``join`` stays global-set-only.
+  The in-graph regime expresses subgroups as mesh axes instead
+  (docs/parallelism.md).
 """
 
 from __future__ import annotations

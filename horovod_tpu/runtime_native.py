@@ -153,7 +153,7 @@ class NativeEngine:
         msg = self._lib.hvd_last_error().decode()
         # Argument errors mirror the Python engine's ValueError surface.
         if any(k in msg for k in ("same name", "out of range", "splits",
-                                  "divisible")):
+                                  "divisible", "per participant")):
             raise ValueError(msg)
         raise RuntimeError(msg)
 
@@ -242,10 +242,12 @@ class NativeEngine:
                 RequestType.BROADCAST, arr, dt, arr.shape)
         return h
 
-    def alltoall_async(self, name, array, splits: Optional[List[int]] = None):
+    def alltoall_async(self, name, array, splits: Optional[List[int]] = None,
+                       process_set=None):
         arr = np.ascontiguousarray(array)
         dt = dtype_from_numpy(arr.dtype)
         nd, dims = self._dims(arr)
+        ps_id, ps_size = self._ps_args(process_set)
         if splits is not None:
             splits = [int(s) for s in splits]
             if sum(splits) != (arr.shape[0] if arr.ndim else 0):
@@ -253,10 +255,11 @@ class NativeEngine:
             carr = (ctypes.c_int64 * len(splits))(*splits)
             h = self._lib.hvd_alltoall_async(
                 name.encode(), arr.ctypes.data, nd, dims, int(dt), carr,
-                len(splits))
+                len(splits), ps_id, ps_size)
         else:
             h = self._lib.hvd_alltoall_async(
-                name.encode(), arr.ctypes.data, nd, dims, int(dt), None, 0)
+                name.encode(), arr.ctypes.data, nd, dims, int(dt), None,
+                0, ps_id, ps_size)
         if h < 0:
             self._raise_enqueue_error()
         with self._meta_lock:

@@ -271,8 +271,20 @@ class SingleProcessEngine(_EngineBase):
                 f"broadcast root rank {root_rank} out of range for size 1")
         return self._finish(name, "BROADCAST", np.asarray(array).copy())
 
-    def alltoall_async(self, name, array, splits=None):
-        return self._finish(name, "ALLTOALL", np.asarray(array).copy())
+    def alltoall_async(self, name, array, splits=None, process_set=None):
+        # Same splits validation as the multi-process engines, so code
+        # written single-process fails the same way it would at scale.
+        self._check_ps(process_set)
+        arr = np.asarray(array)
+        if splits is not None:
+            splits = [int(s) for s in splits]
+            if len(splits) != 1:
+                raise ValueError(
+                    "alltoall needs one split per participant (1)")
+            if sum(splits) != (arr.shape[0] if arr.ndim else 0):
+                raise ValueError("splits must sum to dim 0")
+        # (no-splits divisibility: any dim 0 divides a world of 1)
+        return self._finish(name, "ALLTOALL", arr.copy())
 
     def barrier(self, process_set=None):
         self._check_ps(process_set)
@@ -504,12 +516,21 @@ class PyEngine(_EngineBase):
         return self._enqueue(
             TensorTableEntry(name, arr, h, req, root_rank=root_rank))
 
-    def alltoall_async(self, name, array, splits=None):
+    def alltoall_async(self, name, array, splits=None, process_set=None):
         arr = np.ascontiguousarray(array)
+        ps_id, ps_size = self._ps_fields(process_set)
+        n = ps_size or self.size
         if splits is not None:
             splits = [int(s) for s in splits]
+            if len(splits) != n:
+                raise ValueError(
+                    f"alltoall needs one split per participant ({n})")
             if sum(splits) != arr.shape[0]:
                 raise ValueError("splits must sum to dim 0")
+        elif arr.ndim and arr.shape[0] % n:
+            raise ValueError(
+                "alltoall without splits requires dim 0 divisible by "
+                "the participant count")
         req = Request(
             request_rank=self.rank,
             request_type=RequestType.ALLTOALL,
@@ -517,6 +538,8 @@ class PyEngine(_EngineBase):
             tensor_name=name,
             device="cpu",
             tensor_shape=TensorShape(arr.shape),
+            process_set_id=ps_id,
+            process_set_size=ps_size,
         )
         h = self.handles.allocate()
         entry = TensorTableEntry(name, arr, h, req, splits=splits)
@@ -919,8 +942,8 @@ class PyEngine(_EngineBase):
                  r.process_set_size != first.process_set_size
                  for r in reqs):
             err = f"Mismatched process sets for tensor {name}"
-        elif first.process_set_id and first.request_type in (
-                RequestType.ALLTOALL, RequestType.JOIN):
+        elif first.process_set_id and \
+                first.request_type == RequestType.JOIN:
             err = (f"{_OP_NAMES[first.request_type]} does not support "
                    f"process sets (tensor {name})")
         elif any(r.tensor_type != first.tensor_type for r in reqs):

@@ -328,11 +328,13 @@ def broadcast_(tensor, root_rank, name=None,
 # ---------------------------------------------------------------------------
 
 
-def alltoall_async(tensor, splits=None, name=None) -> int:
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=None) -> int:
     arr = _to_numpy(tensor)
     np_splits = None if splits is None else [int(s) for s in splits]
     h = basics._engine().alltoall_async(
-        _auto_name("torch.alltoall", name), arr, splits=np_splits)
+        _auto_name("torch.alltoall", name), arr, splits=np_splits,
+        process_set=process_set)
     tail_shape = tuple(tensor.shape[1:]) if tensor.dim() > 0 else ()
     want_splits = splits is not None
 
@@ -354,7 +356,7 @@ def alltoall_async(tensor, splits=None, name=None) -> int:
     return _register(h, finalize)
 
 
-def alltoall(tensor, splits=None, name=None):
+def alltoall(tensor, splits=None, name=None, process_set=None):
     """Returns (gathered, received_splits) when splits are given, else
     just the gathered tensor."""
-    return synchronize(alltoall_async(tensor, splits, name))
+    return synchronize(alltoall_async(tensor, splits, name, process_set))

@@ -337,6 +337,31 @@ def scenario_process_sets():
         op=hvd.Sum, name="ps.grouped", process_set=my_ep)
     for out in outs:
         np.testing.assert_allclose(out, sum(r + 1.0 for r in my_ep.ranks))
+    # ragged alltoall within the set: member i sends (j+1) rows to the
+    # j-th member, so member j receives (j+1) rows from EVERY member
+    n = my_ep.size()
+    sr = my_ep.rank()
+    splits = [j + 1 for j in range(n)]
+    rows = sum(splits)
+    x = np.concatenate(
+        [np.full((j + 1, 2), float(rank * 100 + j), np.float32)
+         for j in range(n)])
+    out, recv = hvd.alltoall(x, splits=splits,
+                             name=f"ps.{my_ep.process_set_id}.a2a",
+                             process_set=my_ep)
+    assert list(recv) == [sr + 1] * n, recv
+    expect = np.concatenate(
+        [np.full((sr + 1, 2), float(g * 100 + sr), np.float32)
+         for g in my_ep.ranks])
+    np.testing.assert_allclose(out, expect)
+    # split-count misuse is a local named error
+    try:
+        hvd.alltoall(np.ones((rows, 2), np.float32), splits=[rows],
+                     name="ps.a2a.bad", process_set=my_ep)
+        if n != 1:
+            raise AssertionError("expected split-count error")
+    except ValueError as e:
+        assert "one split per participant" in str(e), e
     # set-scoped barrier: only the members synchronize (the coordinator
     # waits for exactly the members, so this returning at all on every
     # member — while the other set runs its own — is the assertion)
