@@ -31,9 +31,16 @@ from horovod_tpu.runner import secret as secret_mod
 from horovod_tpu.version import __version__
 
 
+def _prog_name() -> str:
+    """Reflect the invoked alias (hvdrun or horovodrun) in usage and
+    error text; module-mode invocations keep the canonical name."""
+    base = os.path.basename(sys.argv[0] or "")
+    return base if base in ("hvdrun", "horovodrun") else "hvdrun"
+
+
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        prog="hvdrun",
+        prog=_prog_name(),
         description="Launch a horovod_tpu distributed job.")
     p.add_argument("-v", "--version", action="version",
                    version=__version__)
@@ -134,14 +141,15 @@ def _collect_env(args):
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = make_parser().parse_args(argv)
     if not args.command:
-        print("hvdrun: no command given", file=sys.stderr)
+        print(f"{_prog_name()}: no command given", file=sys.stderr)
         return 2
     if args.max_restarts < 0:
-        print("hvdrun: --max-restarts must be >= 0 (there is no "
-              "infinite-restart sentinel; pick a bound)", file=sys.stderr)
+        print(f"{_prog_name()}: --max-restarts must be >= 0 (there is "
+              "no infinite-restart sentinel; pick a bound)",
+              file=sys.stderr)
         return 2
     if args.max_restarts and args.launcher == "jsrun":
-        print("hvdrun: --max-restarts is not supported with "
+        print(f"{_prog_name()}: --max-restarts is not supported with "
               "--launcher jsrun (the LSF scheduler owns the job "
               "lifecycle; use its requeue policy)", file=sys.stderr)
         return 2
@@ -192,7 +200,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                 if probe_addr:
                     addr = probe_addr
         except Exception as e:  # discovery must never kill the launch
-            print(f"hvdrun: NIC ring probe failed ({e}); "
+            print(f"{_prog_name()}: NIC ring probe failed ({e}); "
                   "falling back to the default route", file=sys.stderr)
     output = None
     if args.output_filename:
@@ -232,7 +240,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             except LaunchError as e:
                 if attempt >= args.max_restarts:
                     raise
-                print(f"hvdrun: rank {e.rank} exited with code "
+                print(f"{_prog_name()}: rank {e.rank} exited with code "
                       f"{e.returncode}; restarting the job "
                       f"(attempt {attempt + 1}/{args.max_restarts})",
                       file=sys.stderr)

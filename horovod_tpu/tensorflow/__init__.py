@@ -223,15 +223,18 @@ def broadcast(tensor, root_rank=0, name=None, process_set=None):
     return _fn(tf.convert_to_tensor(tensor))
 
 
-def alltoall(tensor, splits=None, name=None):
+def alltoall(tensor, splits=None, name=None, process_set=None):
     nm = _auto_name("tf.alltoall", name)
     x = tf.convert_to_tensor(tensor)
     if splits is None:
-        return _engine_call(lambda v: _eager.alltoall(v, name=nm),
-                            x, x.dtype)
+        return _engine_call(
+            lambda v: _eager.alltoall(v, name=nm,
+                                      process_set=process_set),
+            x, x.dtype)
     sp = [int(s) for s in splits]
     data, recv = tf.py_function(
-        lambda v: _eager.alltoall(v.numpy(), splits=sp, name=nm),
+        lambda v: _eager.alltoall(v.numpy(), splits=sp, name=nm,
+                                  process_set=process_set),
         [x], [x.dtype, tf.int64])
     return data, recv
 
