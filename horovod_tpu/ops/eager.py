@@ -41,17 +41,23 @@ def _auto_name(kind: str, name: Optional[str]) -> str:
     return f"{kind}.noname.{c}"
 
 
-def _check_not_traced(x) -> None:
+def _is_traced(x) -> bool:
     try:
         import jax.core
 
-        if isinstance(x, jax.core.Tracer):
-            raise TypeError(
-                "eager horovod_tpu collectives cannot run inside jit/pjit "
-                "traces; use horovod_tpu.ops.collective.* (axis-name based "
-                "in-graph collectives) inside shard_map instead")
+        return isinstance(x, jax.core.Tracer)
     except ImportError:
-        pass
+        return False
+
+
+def _check_not_traced(x) -> None:
+    if _is_traced(x):
+        raise TypeError(
+            "async horovod_tpu collectives cannot run inside jit/pjit "
+            "traces (handles are host-side); the sync ops dispatch to "
+            "horovod_tpu.ops.bridge (engine-negotiated host callback) "
+            "inside jit, and horovod_tpu.ops.collective.* are the "
+            "axis-name in-graph collectives for shard_map/pjit meshes")
 
 
 def _to_numpy(x) -> Tuple[np.ndarray, Callable[[np.ndarray], Any]]:
@@ -176,6 +182,17 @@ def allreduce(tensor, average: Optional[bool] = None,
               prescale_factor: float = 1.0,
               postscale_factor: float = 1.0,
               compression=None, process_set=None):
+    if _is_traced(tensor):
+        # Inside a jit trace the sync surface rides the engine through
+        # the host-callback bridge (negotiation/fusion/cache/timeline on
+        # the compiled path) — the TPU analog of ComputeAsync-enqueue.
+        from horovod_tpu.ops import bridge
+
+        return bridge.allreduce(
+            tensor, name=name, op=_resolve_op(op, average),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            compression=compression, process_set=process_set)
     return synchronize(allreduce_async(
         tensor, average, name, op, prescale_factor, postscale_factor,
         compression, process_set))
@@ -188,6 +205,11 @@ def grouped_allreduce(tensors: List, average: Optional[bool] = None,
     """Eager grouped allreduce; entries negotiate individually but fuse in
     the controller exactly like individually-submitted tensors do."""
     op = _resolve_op(op, average)
+    if any(_is_traced(t) for t in tensors):
+        from horovod_tpu.ops import bridge
+
+        return list(bridge.grouped_allreduce(
+            list(tensors), name=name, op=op, process_set=process_set))
     base = _auto_name("grouped_allreduce", name)
     handles = [allreduce_async(t, name=f"{base}.{i}", op=op,
                                process_set=process_set)
@@ -204,6 +226,10 @@ def allgather_async(tensor, name: Optional[str] = None,
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
+    if _is_traced(tensor):
+        from horovod_tpu.ops import bridge
+
+        return bridge.allgather(tensor, name=name, process_set=process_set)
     return synchronize(allgather_async(tensor, name, process_set))
 
 
@@ -262,6 +288,12 @@ def reducescatter_async(tensor, average: Optional[bool] = None,
 def reducescatter(tensor, average: Optional[bool] = None,
                   name: Optional[str] = None,
                   op: Optional[ReduceOp] = None, process_set=None):
+    if _is_traced(tensor):
+        from horovod_tpu.ops import bridge
+
+        return bridge.reducescatter(tensor, name=name,
+                                    op=_resolve_op(op, average),
+                                    process_set=process_set)
     return synchronize(reducescatter_async(tensor, average, name, op,
                                            process_set))
 
@@ -277,6 +309,11 @@ def broadcast_async(tensor, root_rank: int = 0,
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
+    if _is_traced(tensor):
+        from horovod_tpu.ops import bridge
+
+        return bridge.broadcast(tensor, root_rank=root_rank, name=name,
+                                process_set=process_set)
     return synchronize(broadcast_async(tensor, root_rank, name,
                                        process_set))
 
@@ -301,6 +338,17 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
+    if _is_traced(tensor):
+        if splits is not None:
+            sp = [int(s) for s in np.asarray(splits)]
+            if len(set(sp)) != 1 or sum(sp) != tensor.shape[0]:
+                raise NotImplementedError(
+                    "ragged alltoall needs runtime shapes, which jit "
+                    "cannot express; only uniform splits covering dim 0 "
+                    "work in-trace — move ragged calls out of the trace")
+        from horovod_tpu.ops import bridge
+
+        return bridge.alltoall(tensor, name=name, process_set=process_set)
     return synchronize(alltoall_async(tensor, splits, name, process_set))
 
 

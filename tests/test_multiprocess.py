@@ -103,7 +103,7 @@ _HIER_ENV = {"HVD_HIERARCHICAL_ALLREDUCE": "1",
 _GANG_SCENARIOS = {
     # (np, profile) -> ordered scenario list
     (2, "plain"): ["allreduce", "fusion", "allgather", "barrier",
-                   "resume_or_init"],
+                   "resume_or_init", "bridge_jit"],
     (3, "plain"): ["allgather", "broadcast", "sparse_allreduce",
                    "alltoall", "reducescatter"],
     (4, "plain"): ["allreduce", "adasum"],
@@ -312,6 +312,27 @@ def test_stall_detection_and_shutdown(engine):
                        })
     rank0_err = outs[0][2]
     assert "Stalled tensor" in rank0_err, rank0_err[-2000:]
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_bridge_jit(engine):
+    """Jitted-step collectives ride the negotiated engine, bitwise equal
+    to the eager ring (the custom-call/FFI bridge — SURVEY §7 'hard
+    parts'; reference mechanism tensorflow/mpi_ops.cc:287-320)."""
+    assert_gang("bridge_jit", 2, engine)
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_bridge_timeline(tmp_path, engine):
+    """A bridge tensor shows full negotiation in the timeline: the
+    compiled path is on the controller, observably."""
+    path = str(tmp_path / f"bridge_timeline_{engine}.json")
+    run_workers("bridge_timeline", 2,
+                extra_env={"HVD_TIMELINE": path}, engine=engine)
+    with open(path) as f:
+        content = f.read()
+    assert "NEGOTIATE_ALLREDUCE" in content
+    assert "brtl.tensor" in content
 
 
 @pytest.mark.parametrize("engine", ENGINES)
