@@ -96,7 +96,19 @@ def _check_single_device_trace() -> None:
         import jax.core
 
         nonempty = jax.core.nonempty_axis_env_DO_NOT_USE()
-    except (ImportError, AttributeError):
+    except ImportError:
+        return
+    except AttributeError:
+        # The probe API was removed by a jax upgrade: the guard cannot
+        # run, and a shard_map misuse would hang instead of raising.
+        # Warn (once, via the default dedup) rather than fail silently.
+        import warnings
+
+        warnings.warn(
+            "horovod_tpu: cannot detect shard_map/pmap context on this "
+            "jax version; engine-bridge collectives called inside "
+            "shard_map bodies will misbehave instead of raising. Use "
+            "ops.collective there.", RuntimeWarning, stacklevel=3)
         return
     if nonempty:
         raise TypeError(

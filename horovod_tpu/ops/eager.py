@@ -340,7 +340,13 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
     if _is_traced(tensor):
         if splits is not None:
+            n = (len(process_set.ranks) if process_set is not None
+                 else basics.size())
             sp = [int(s) for s in np.asarray(splits)]
+            if len(sp) != n:
+                raise ValueError(
+                    f"alltoall needs one split per participant ({n}), "
+                    f"got {len(sp)}")
             if len(set(sp)) != 1 or sum(sp) != tensor.shape[0]:
                 raise NotImplementedError(
                     "ragged alltoall needs runtime shapes, which jit "

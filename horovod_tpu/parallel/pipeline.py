@@ -97,7 +97,7 @@ def gpipe(stage_fn, x_mb, *, axis: str = "pp"):
     # The carry becomes pp-varying after one tick (each stage holds its
     # own activations), so it must *start* varying for scan's type check.
     carry0 = jax.tree.map(
-        lambda a: lax.pvary(a, axis),
+        lambda a: lax.pcast(a, axis, to="varying"),
         (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb),
          jnp.zeros((), jnp.float32)))
     (_, out, aux_sum), _ = lax.scan(tick, carry0, jnp.arange(ticks))

@@ -58,11 +58,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "binds to and advertises the first one that "
                         "resolves (default: automatic via the default "
                         "route)")
-    p.add_argument("--launcher", choices=["spawn", "jsrun"],
+    p.add_argument("--launcher", choices=["spawn", "jsrun", "mpirun"],
                    default="spawn",
                    help="spawn: local subprocess / ssh per slot (default); "
-                        "jsrun: one jsrun invocation on an LSF cluster "
-                        "(parity: horovodrun's gloo/jsrun modes)")
+                        "jsrun: one jsrun invocation on an LSF cluster; "
+                        "mpirun: one mpirun invocation driving an MPI "
+                        "cluster (OpenMPI or Hydra/MPICH; tasks need no "
+                        "MPI linkage — rank comes from the env, data "
+                        "rides this stack's own mesh) "
+                        "(parity: horovodrun's gloo/jsrun/mpirun modes)")
     p.add_argument("--start-timeout", type=int, default=120,
                    dest="start_timeout")
     p.add_argument("--max-restarts", type=int, default=0,
@@ -148,11 +152,25 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               "no infinite-restart sentinel; pick a bound)",
               file=sys.stderr)
         return 2
-    if args.max_restarts and args.launcher == "jsrun":
+    if args.max_restarts and args.launcher in ("jsrun", "mpirun"):
         print(f"{_prog_name()}: --max-restarts is not supported with "
-              "--launcher jsrun (the LSF scheduler owns the job "
-              "lifecycle; use its requeue policy)", file=sys.stderr)
+              f"--launcher {args.launcher} (the external scheduler owns "
+              "the job lifecycle; use its requeue policy)",
+              file=sys.stderr)
         return 2
+    mpi_impl = None
+    if args.launcher == "mpirun":
+        # Probe before any rendezvous/ssh side effects: a missing
+        # mpirun should fail in milliseconds, not after a NIC ring
+        # probe across the cluster.
+        from horovod_tpu.runner import mpi
+
+        mpi_impl = mpi.detect_mpi_impl()
+        if mpi_impl is None:
+            print(f"{_prog_name()}: --launcher mpirun: no usable "
+                  "mpirun found on PATH (need OpenMPI or a "
+                  "Hydra-family MPICH)", file=sys.stderr)
+            return 2
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -221,6 +239,31 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             return subprocess.run(
                 lsf.jsrun_command(args.np, command), env=env,
                 stdout=output or None).returncode
+        if args.launcher == "mpirun":
+            # One mpirun fan-out (parity: run/mpi_run.py:81-158): tasks
+            # get rank/size from the OMPI_*/PMI_* env and rendezvous
+            # back here.  Env values live in the launcher's process
+            # environment and are forwarded by NAME (-x / -genvlist) —
+            # never values on the ps-visible command line.
+            import subprocess
+
+            from horovod_tpu.runner import mpi
+
+            env = dict(os.environ)
+            env.update(env_extra)
+            env.update({"HVD_RENDEZVOUS_ADDR": addr,
+                        "HVD_RENDEZVOUS_PORT": str(port)})
+            names = sorted(set(env_extra)
+                           | {"HVD_RENDEZVOUS_ADDR",
+                              "HVD_RENDEZVOUS_PORT"})
+            cmd = mpi.mpirun_command(
+                args.np, slots, command, env_var_names=names,
+                impl=mpi_impl,
+                nics=args.nics.split(",") if args.nics else None,
+                ssh_port=args.ssh_port,
+                ssh_identity_file=args.ssh_identity_file)
+            return subprocess.run(
+                cmd, env=env, stdout=output or None).returncode
         from horovod_tpu.runner.launch import LaunchError
 
         for attempt in range(args.max_restarts + 1):

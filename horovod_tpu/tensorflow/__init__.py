@@ -319,6 +319,7 @@ def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none,
                          op=ReduceOp.AVERAGE,
                          backward_passes_per_step=1,
+                         average_aggregated_gradients=False,
                          process_set=None):
     """Wraps a Keras-3 optimizer: gradients are allreduced before being
     applied (parity: tensorflow/__init__.py:266-311 — there via
@@ -333,21 +334,32 @@ def DistributedOptimizer(optimizer, name=None,
     The instance is re-classed in place (same dynamic-subclass technique
     as the reference) so restored slot state and the iteration counter
     survive — important when wrapping an optimizer loaded from a
-    checkpoint."""
-    if backward_passes_per_step != 1:
-        raise NotImplementedError(
-            "backward_passes_per_step > 1 is not supported by the "
-            "TensorFlow front-end yet; accumulate gradients in the "
-            "training loop, or use horovod_tpu.torch which implements "
-            "it natively.")
+    checkpoint.
+
+    ``backward_passes_per_step=N`` aggregates gradients locally over N
+    ``apply_gradients`` calls and allreduces+applies only on the Nth
+    (parity: ``LocalGradientAggregationHelper``, the reference's
+    tensorflow/__init__.py:443 path); skipped calls leave the variables
+    and slots untouched.  ``average_aggregated_gradients`` divides the
+    local sum by N before the allreduce, as in the reference."""
     base_cls = optimizer.__class__
     _op = op
     _compression = compression
     _ps = process_set
+    _bpps = int(backward_passes_per_step)
+    _avg_agg = average_aggregated_gradients
+    if _bpps < 1:
+        raise ValueError(
+            f"backward_passes_per_step must be >= 1, got {_bpps}")
 
     if op == ReduceOp.ADASUM:
         if process_set is not None:
             raise ValueError("Adasum does not support process sets")
+        if _bpps != 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 is incompatible with the "
+                "Adasum delta-model wrapper (the delta must be computed "
+                "per applied step)")
         class _WrappedAdasum(base_cls):
             def apply_gradients(self, grads_and_vars, *args, **kwargs):
                 gv = list(grads_and_vars)
@@ -368,16 +380,69 @@ def DistributedOptimizer(optimizer, name=None,
 
     class _Wrapped(base_cls):
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            sup = super()
             grads_and_vars = list(grads_and_vars)
             grads = [g for g, _ in grads_and_vars]
             tvars = [v for _, v in grads_and_vars]
-            reduced = [
-                allreduce(g, op=_op, compression=_compression,
-                          name=f"do.{i}", process_set=_ps)
-                if g is not None else None
-                for i, g in enumerate(grads)]
-            return super().apply_gradients(
-                zip(reduced, tvars), *args, **kwargs)
+
+            def _reduce_apply(gs):
+                reduced = [
+                    allreduce(g, op=_op, compression=_compression,
+                              name=f"do.{i}", process_set=_ps)
+                    if g is not None else None
+                    for i, g in enumerate(gs)]
+                return sup.apply_gradients(
+                    zip(reduced, tvars), *args, **kwargs)
+
+            if _bpps == 1:
+                return _reduce_apply(grads)
+
+            # Graph-compatible local aggregation (reference:
+            # LocalGradientAggregationHelper — tf.Variable state +
+            # tf.cond, so a tf.function-compiled train step re-evaluates
+            # the pass counter at run time instead of baking the
+            # trace-time branch in).  Accumulators are created under
+            # init_scope so the first call may itself be inside a trace;
+            # object.__setattr__ sidesteps Keras's attribute tracking,
+            # which wraps plain lists in copies.
+            if getattr(self, "_hvd_agg_acc", None) is None:
+                with tf.init_scope():
+                    # One accumulator per variable regardless of the
+                    # first call's None pattern: a head untouched by the
+                    # first microbatch must still aggregate later ones
+                    # (its untouched accumulator contributes zeros).
+                    accs = [tf.Variable(tf.zeros_like(v), trainable=False)
+                            for v in tvars]
+                    counter = tf.Variable(0, dtype=tf.int64,
+                                          trainable=False)
+                object.__setattr__(self, "_hvd_agg_acc", accs)
+                object.__setattr__(self, "_hvd_agg_counter", counter)
+            accs = self._hvd_agg_acc
+            counter = self._hvd_agg_counter
+            # Slot variables cannot be created inside a tf.cond branch;
+            # force the lazy build before entering it.
+            if hasattr(self, "build") and not getattr(self, "built", True):
+                self.build(tvars)
+            for a, g in zip(accs, grads):
+                if g is not None:
+                    a.assign_add(tf.convert_to_tensor(g))
+            counter.assign_add(1)
+
+            def _apply_branch():
+                gs = [tf.convert_to_tensor(a) for a in accs]
+                if _avg_agg:
+                    gs = [g / _bpps for g in gs]
+                _reduce_apply(gs)
+                for a in accs:
+                    a.assign(tf.zeros_like(a))
+                return tf.constant(True)
+
+            def _skip_branch():
+                # Aggregation-only pass: no collective, no update.
+                return tf.constant(False)
+
+            return tf.cond(tf.equal(counter % _bpps, 0),
+                           _apply_branch, _skip_branch)
 
     _Wrapped.__name__ = f"Distributed{base_cls.__name__}"
     optimizer.__class__ = _Wrapped

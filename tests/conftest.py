@@ -72,6 +72,7 @@ _SLOW_NODEIDS = (
     "test_torch_binding.py::test_torch_join",
     # (optimizer_accumulate now rides the 2-proc torch gang for free)
     "test_launcher_e2e.py::test_cli_four_proc",
+    "test_packaging.py::test_wheel_builds_installs_and_runs",
     "test_pipeline.py::test_pipeline_forward_matches_dense[4]",
     "test_pipeline.py::test_pipeline_microbatch_count",
     "test_pipeline.py::test_pipeline_train_step_matches_plain",

@@ -80,6 +80,12 @@ def test_keras_fit():
     run_tf_workers("keras_fit", 2)
 
 
+def test_tf_backward_passes_per_step():
+    # Local gradient aggregation over N passes, exact math at 2 ranks
+    # (ref tensorflow/__init__.py:443).
+    run_tf_workers("backward_passes", 2)
+
+
 def test_tf_adasum_optimizer_golden():
     # Delta-model Adasum wrapper at 4 ranks vs the numpy VHDD oracle,
     # through apply_gradients (ref tensorflow/__init__.py:313-407).

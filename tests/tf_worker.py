@@ -207,6 +207,65 @@ def scenario_adasum_optimizer():
     np.testing.assert_allclose(v.numpy(), expect, rtol=1e-4, atol=1e-5)
 
 
+def scenario_backward_passes():
+    # Local gradient aggregation (parity: reference
+    # tensorflow/__init__.py:443 backward_passes_per_step via
+    # LocalGradientAggregationHelper): N-1 calls accumulate without
+    # touching variables; the Nth allreduces the sum and applies.
+    import keras
+
+    rank, size = hvd.rank(), hvd.size()
+    start = np.linspace(0.0, 1.1, 12, dtype=np.float32).reshape(3, 4)
+    lr = 0.1
+    rs = [np.random.RandomState(100 + r) for r in range(size)]
+    g_all = [[rs[r].randn(3, 4).astype(np.float32) for _ in range(4)]
+             for r in range(size)]
+
+    v = tf.Variable(start.copy())
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=lr), backward_passes_per_step=2)
+    opt.apply_gradients([(tf.constant(g_all[rank][0]), v)])
+    np.testing.assert_allclose(v.numpy(), start, rtol=0, atol=0,
+                               err_msg="variable moved on an "
+                               "aggregation-only pass")
+    opt.apply_gradients([(tf.constant(g_all[rank][1]), v)])
+    mean_sum = np.mean(
+        [g_all[r][0] + g_all[r][1] for r in range(size)], axis=0)
+    np.testing.assert_allclose(v.numpy(), start - lr * mean_sum,
+                               rtol=1e-5, atol=1e-6)
+
+    # under tf.function: the pass counter must be graph state (tf.cond),
+    # not a trace-time Python branch — both passes share ONE trace here
+    v3 = tf.Variable(start.copy())
+    opt3 = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=lr), backward_passes_per_step=2)
+
+    @tf.function
+    def train(g):
+        return opt3.apply_gradients([(g, v3)])
+
+    applied1 = train(tf.constant(g_all[rank][0]))
+    np.testing.assert_allclose(v3.numpy(), start, rtol=0, atol=0,
+                               err_msg="compiled aggregation-only pass "
+                               "moved the variable")
+    applied2 = train(tf.constant(g_all[rank][1]))
+    np.testing.assert_allclose(v3.numpy(), start - lr * mean_sum,
+                               rtol=1e-5, atol=1e-6)
+    assert not bool(applied1) and bool(applied2)
+
+    # average_aggregated_gradients divides the local sum by N pre-wire
+    v2 = tf.Variable(start.copy())
+    opt2 = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=lr), backward_passes_per_step=2,
+        average_aggregated_gradients=True)
+    opt2.apply_gradients([(tf.constant(g_all[rank][2]), v2)])
+    opt2.apply_gradients([(tf.constant(g_all[rank][3]), v2)])
+    mean_avg = np.mean(
+        [(g_all[r][2] + g_all[r][3]) / 2.0 for r in range(size)], axis=0)
+    np.testing.assert_allclose(v2.numpy(), start - lr * mean_avg,
+                               rtol=1e-5, atol=1e-6)
+
+
 SCENARIOS = {k[len("scenario_"):]: v for k, v in list(globals().items())
              if k.startswith("scenario_")}
 
