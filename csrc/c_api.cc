@@ -12,6 +12,7 @@
 // are owned by the engine until hvd_release().
 
 #include <cstring>
+#include <chrono>
 #include <memory>
 #include <string>
 #include <vector>
@@ -284,6 +285,38 @@ void hvd_cache_stats(int64_t* out) {
     return;
   }
   g_engine->CacheStats(out);
+}
+
+// Microbenchmark hook for the wire-codec combine loops (the per-hop hot
+// path of compressed ring traffic; parity target: half.cc:43-77's
+// vectorized fp16 sum).  Runs `iters` combines of an n-element buffer
+// of dtype `dt` and returns elements/second.  The SIMD/scalar split is
+// selected by the HVD_NO_SIMD env read at first use, so callers bench
+// each side in a fresh process.  Needs no engine.
+// Test-only: raw per-hop combine on caller buffers (dst <- combine(in,
+// dst)).  Lets the suite pin SIMD and scalar paths bit-for-bit against
+// each other across processes (HVD_NO_SIMD toggles at load time).
+void hvd_combine_into(void* dst, const void* in, uint64_t n, int dt,
+                      int op) {
+  hvd::CombineInto(dst, in, n, static_cast<hvd::DataType>(dt),
+                   static_cast<hvd::ReduceOp>(op));
+}
+
+double hvd_bench_combine(int dt, uint64_t n, int iters) {
+  std::vector<uint8_t> a(n * 8, 0), b(n * 8, 0);
+  auto t = static_cast<hvd::DataType>(dt);
+  // deterministic non-trivial bit patterns valid for every dtype
+  for (size_t i = 0; i < a.size(); ++i) {
+    a[i] = static_cast<uint8_t>((i * 37u + 11u) & 0x3fu);
+    b[i] = static_cast<uint8_t>((i * 53u + 7u) & 0x3fu);
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  for (int it = 0; it < iters; ++it)
+    hvd::CombineInto(a.data(), b.data(), n, t, hvd::ReduceOp::SUM);
+  auto dt_s = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0)
+                  .count();
+  return dt_s > 0 ? static_cast<double>(n) * iters / dt_s : 0.0;
 }
 
 }  // extern "C"

@@ -2,7 +2,17 @@
 
 #include <algorithm>
 #include <cmath>
+#include <cstdlib>
 #include <cstring>
+#include <vector>
+
+#if (defined(__x86_64__) || defined(_M_X64)) && \
+    (defined(__GNUC__) || defined(__clang__))
+// GCC/Clang only: the fast paths use __builtin_cpu_supports and
+// __attribute__((target)) — other compilers take the scalar loops.
+#define HVD_X86 1
+#include <immintrin.h>
+#endif
 
 namespace hvd {
 
@@ -219,6 +229,199 @@ void CombineBool(uint8_t* dst, const uint8_t* in, size_t n, ReduceOp op) {
 
 }  // namespace
 
+namespace {
+
+// ---------------------------------------------------------------------
+// SIMD fast paths for the sub-32-bit wire dtypes (parity: half.cc:43-77,
+// the reference's F16C/AVX fused fp16 sum).  The ring's per-hop combine
+// decodes both operands to f32, reduces, and re-encodes RNE — with
+// scalar bit-twiddling that is the hot loop of every compressed-wire
+// hop.  F16C gives hardware fp16<->f32; bf16 is two integer ops; fp8
+// decodes through a 256-entry table.  Dispatch is runtime-gated on
+// AVX2+F16C (so the binary still runs on older hosts) and on
+// HVD_NO_SIMD=1 (the microbenchmark's scalar baseline switch).
+
+bool SimdAvailable() {
+#ifdef HVD_X86
+  static const bool ok = [] {
+    __builtin_cpu_init();
+    return __builtin_cpu_supports("avx2") &&
+           __builtin_cpu_supports("f16c");
+  }();
+  return ok;
+#else
+  return false;
+#endif
+}
+
+bool FastPathsRequested() {
+  static const bool on = [] {
+    const char* e = std::getenv("HVD_NO_SIMD");
+    return !(e && e[0] == '1');
+  }();
+  return on;
+}
+
+bool SimdEnabled() { return FastPathsRequested() && SimdAvailable(); }
+
+// The fp8 pairwise tables are plain C++ (no vector ISA) — every
+// architecture gets them; HVD_NO_SIMD=1 still forces the scalar
+// codec loops so the microbenchmark has its baseline.
+bool TablesEnabled() { return FastPathsRequested(); }
+
+#ifdef HVD_X86
+
+__attribute__((target("avx2")))
+inline __m256 CombineVec(__m256 a, __m256 b, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::MIN:
+      return _mm256_min_ps(a, b);
+    case ReduceOp::MAX:
+      return _mm256_max_ps(a, b);
+    case ReduceOp::PRODUCT:
+      return _mm256_mul_ps(a, b);
+    default:  // SUM / AVERAGE / ADASUM accumulate
+      return _mm256_add_ps(a, b);
+  }
+}
+
+__attribute__((target("avx2,f16c")))
+void CombineHalfSimd(uint16_t* d, const uint16_t* s, size_t n,
+                     ReduceOp op) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 a = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(s + i)));
+    __m256 b = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(d + i)));
+    __m256 r = CombineVec(a, b, op);
+    _mm_storeu_si128(
+        reinterpret_cast<__m128i*>(d + i),
+        _mm256_cvtps_ph(r, _MM_FROUND_TO_NEAREST_INT |
+                               _MM_FROUND_NO_EXC));
+  }
+  for (; i < n; ++i)
+    d[i] = FloatToHalf(CombineF32(HalfToFloat(s[i]), HalfToFloat(d[i]),
+                                  op));
+}
+
+__attribute__((target("avx2")))
+void CombineBf16Simd(uint16_t* d, const uint16_t* s, size_t n,
+                     ReduceOp op) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i a32 = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(s + i))), 16);
+    __m256i b32 = _mm256_slli_epi32(
+        _mm256_cvtepu16_epi32(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(d + i))), 16);
+    __m256 r = CombineVec(_mm256_castsi256_ps(a32),
+                          _mm256_castsi256_ps(b32), op);
+    // NaN results (inf + -inf, NaN inputs) need the scalar quietization
+    // path to stay bit-identical to FloatToBf16; they are vanishingly
+    // rare on gradient traffic, so punt the whole block.
+    if (_mm256_movemask_ps(_mm256_cmp_ps(r, r, _CMP_UNORD_Q))) {
+      for (size_t j = i; j < i + 8; ++j)
+        d[j] = FloatToBf16(CombineF32(Bf16ToFloat(s[j]),
+                                      Bf16ToFloat(d[j]), op));
+      continue;
+    }
+    // RNE encode: u += 0x7fff + ((u >> 16) & 1); u >>= 16 — the exact
+    // integer form FloatToBf16 uses.
+    __m256i u = _mm256_castps_si256(r);
+    __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(u, 16),
+                                   _mm256_set1_epi32(1));
+    u = _mm256_add_epi32(
+        u, _mm256_add_epi32(lsb, _mm256_set1_epi32(0x7fff)));
+    u = _mm256_srli_epi32(u, 16);
+    // pack 8 x u32 (low u16 significant) into 8 x u16
+    __m256i packed = _mm256_packus_epi32(
+        u, _mm256_permute2x128_si256(u, u, 0x01));
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(d + i),
+                     _mm256_castsi256_si128(packed));
+  }
+  for (; i < n; ++i)
+    d[i] = FloatToBf16(CombineF32(Bf16ToFloat(s[i]), Bf16ToFloat(d[i]),
+                                  op));
+}
+
+#endif  // HVD_X86
+
+// fp8 pairwise tables: a combine's domain is only 256×256 inputs, so
+// one 64 KB table per (dtype, op-class) makes the per-hop hot loop a
+// single lookup per element — with exactness inherited from the scalar
+// codecs that fill it (decode → CombineF32 → encode, bit for bit).
+// Magic-statics make the lazy build thread-safe; build cost is 65536
+// scalar combines, microseconds.
+int OpClass(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::MIN:
+      return 1;
+    case ReduceOp::MAX:
+      return 2;
+    case ReduceOp::PRODUCT:
+      return 3;
+    default:  // SUM / AVERAGE / ADASUM all accumulate via +
+      return 0;
+  }
+}
+
+ReduceOp ClassOp(int cls) {
+  switch (cls) {
+    case 1:
+      return ReduceOp::MIN;
+    case 2:
+      return ReduceOp::MAX;
+    case 3:
+      return ReduceOp::PRODUCT;
+    default:
+      return ReduceOp::SUM;
+  }
+}
+
+template <int KIND, int OPC>  // KIND: 0 = e4m3fn, 1 = e5m2
+const uint8_t* Fp8PairTable() {
+  static const std::vector<uint8_t>* table = [] {
+    auto* t = new std::vector<uint8_t>(65536);
+    for (int a = 0; a < 256; ++a) {
+      float fa = KIND == 0 ? Fp8E4m3ToFloat(static_cast<uint8_t>(a))
+                           : Fp8E5m2ToFloat(static_cast<uint8_t>(a));
+      for (int b = 0; b < 256; ++b) {
+        float fb = KIND == 0 ? Fp8E4m3ToFloat(static_cast<uint8_t>(b))
+                             : Fp8E5m2ToFloat(static_cast<uint8_t>(b));
+        float r = CombineF32(fa, fb, ClassOp(OPC));
+        (*t)[(a << 8) | b] =
+            KIND == 0 ? FloatToFp8E4m3(r) : FloatToFp8E5m2(r);
+      }
+    }
+    return t;
+  }();
+  return table->data();
+}
+
+template <int KIND>
+const uint8_t* Fp8PairTableFor(ReduceOp op) {
+  switch (OpClass(op)) {
+    case 1:
+      return Fp8PairTable<KIND, 1>();
+    case 2:
+      return Fp8PairTable<KIND, 2>();
+    case 3:
+      return Fp8PairTable<KIND, 3>();
+    default:
+      return Fp8PairTable<KIND, 0>();
+  }
+}
+
+void CombineFp8Pairwise(uint8_t* d, const uint8_t* s, size_t n,
+                        const uint8_t* table) {
+  for (size_t i = 0; i < n; ++i)
+    d[i] = table[(static_cast<size_t>(s[i]) << 8) | d[i]];
+}
+
+}  // namespace
+
 void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
                  ReduceOp op) {
   switch (dt) {
@@ -261,6 +464,12 @@ void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
     case DataType::FLOAT16: {
       auto* d = static_cast<uint16_t*>(dst);
       auto* s = static_cast<const uint16_t*>(incoming);
+#ifdef HVD_X86
+      if (SimdEnabled()) {
+        CombineHalfSimd(d, s, n, op);
+        break;
+      }
+#endif
       for (size_t i = 0; i < n; ++i)
         d[i] = FloatToHalf(
             CombineF32(HalfToFloat(s[i]), HalfToFloat(d[i]), op));
@@ -269,6 +478,12 @@ void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
     case DataType::BFLOAT16: {
       auto* d = static_cast<uint16_t*>(dst);
       auto* s = static_cast<const uint16_t*>(incoming);
+#ifdef HVD_X86
+      if (SimdEnabled()) {
+        CombineBf16Simd(d, s, n, op);
+        break;
+      }
+#endif
       for (size_t i = 0; i < n; ++i)
         d[i] = FloatToBf16(
             CombineF32(Bf16ToFloat(s[i]), Bf16ToFloat(d[i]), op));
@@ -277,6 +492,10 @@ void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
     case DataType::FLOAT8_E4M3: {
       auto* d = static_cast<uint8_t*>(dst);
       auto* s = static_cast<const uint8_t*>(incoming);
+      if (TablesEnabled()) {  // exact pairwise table, one load/element
+        CombineFp8Pairwise(d, s, n, Fp8PairTableFor<0>(op));
+        break;
+      }
       for (size_t i = 0; i < n; ++i)
         d[i] = FloatToFp8E4m3(
             CombineF32(Fp8E4m3ToFloat(s[i]), Fp8E4m3ToFloat(d[i]), op));
@@ -285,6 +504,10 @@ void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
     case DataType::FLOAT8_E5M2: {
       auto* d = static_cast<uint8_t*>(dst);
       auto* s = static_cast<const uint8_t*>(incoming);
+      if (TablesEnabled()) {
+        CombineFp8Pairwise(d, s, n, Fp8PairTableFor<1>(op));
+        break;
+      }
       for (size_t i = 0; i < n; ++i)
         d[i] = FloatToFp8E5m2(
             CombineF32(Fp8E5m2ToFloat(s[i]), Fp8E5m2ToFloat(d[i]), op));

@@ -1,0 +1,212 @@
+"""Host data-plane microbenchmark: ring throughput vs message size.
+
+The eager engine's analog of the reference's NCCL bandwidth sweeps and
+the surface its autotuner actually scores (bytes/s per sample window,
+``parameter_manager.cc:89-181``).  Two modes:
+
+* **driver** (default, no ``HVD_SIZE`` in env): spawns its own N-rank
+  gang per configuration — engine {native, py} × fusion {on, off} —
+  collects every rank-0 JSON line, and prints a markdown table plus
+  one ``RESULT {...}`` JSON line per cell.
+
+* **worker** (``HVD_SIZE`` set — i.e. under ``hvdrun`` or the driver):
+  times two workloads over the live mesh:
+
+  1. *bandwidth sweep*: one tensor per step, 64 KB → 64 MB, wire dtype
+     {fp32, fp16, fp8(e4m3)}; reports algorithm bandwidth
+     (payload_bytes / wall) and ring bus bandwidth
+     (2·(n−1)/n · payload / wall — the NCCL convention).
+  2. *fusion sweep*: the same total payload as 64 equal async tensors
+     synchronized together — the controller either fuses them into
+     large wire messages (``HVD_FUSION_THRESHOLD`` high) or ships 64
+     separate rings (0).  This is the workload tensor fusion exists
+     for (fusion_buffer_manager.h:28-55).
+
+Run standalone::
+
+    python examples/engine_benchmark.py --np 4          # full matrix
+    python examples/engine_benchmark.py --np 2 --quick  # small sizes
+
+or a single configuration under the launcher::
+
+    hvdrun -np 4 --fusion-threshold-mb 64 -- \
+        python examples/engine_benchmark.py
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wire_dtypes():
+    import ml_dtypes
+
+    from horovod_tpu.ops.compression import Compression
+
+    return [("fp32", Compression.none, np.float32),
+            ("fp16", Compression.fp16, np.float32),
+            ("fp8", Compression.fp8, np.float32)]
+
+
+def worker(args) -> None:
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, n = hvd.rank(), hvd.size()
+    sizes = ([1 << 16, 1 << 20] if args.quick
+             else [1 << 16, 1 << 18, 1 << 20, 1 << 23, 1 << 26])
+    results = []
+
+    def timed(fn, payload_bytes, iters):
+        fn()  # warm the path (socket buffers, name negotiation)
+        hvd.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        dt = (time.perf_counter() - t0) / iters
+        alg = payload_bytes / dt
+        bus = 2.0 * (n - 1) / n * payload_bytes / dt
+        return alg / 1e6, bus / 1e6, dt * 1e3
+
+    # 1. bandwidth sweep: one tensor per step
+    for label, comp, dt_ in _wire_dtypes():
+        for size in sizes:
+            count = size // np.dtype(dt_).itemsize
+            x = np.random.RandomState(rank).randn(count).astype(dt_)
+            iters = max(2, min(30, (1 << 24) // size))
+            name = f"bw.{label}.{size}"
+
+            def one():
+                hvd.allreduce(x, op=hvd.Sum, name=name, compression=comp)
+
+            alg, bus, ms = timed(one, size, iters)
+            results.append(dict(mode="single", wire=label, bytes=size,
+                                alg_mb_s=round(alg, 1),
+                                bus_mb_s=round(bus, 1),
+                                ms_per_op=round(ms, 3)))
+
+    # 2. fusion sweep: 64 equal tensors submitted async, synced together
+    for size in sizes:
+        k = 64
+        count = max(1, size // k // 4)
+        xs = [np.random.RandomState(rank + i).randn(count)
+              .astype(np.float32) for i in range(k)]
+        payload = count * 4 * k
+        iters = max(2, min(20, (1 << 23) // max(payload, 1)))
+        base = f"fuse.{size}"
+
+        def grouped():
+            hs = [hvd.allreduce_async(xs[i], op=hvd.Sum,
+                                      name=f"{base}.{i}")
+                  for i in range(k)]
+            for h in hs:
+                hvd.synchronize(h)
+
+        alg, bus, ms = timed(grouped, payload, iters)
+        results.append(dict(mode="grouped64", wire="fp32", bytes=payload,
+                            alg_mb_s=round(alg, 1),
+                            bus_mb_s=round(bus, 1),
+                            ms_per_op=round(ms, 3)))
+
+    if rank == 0:
+        for r in results:
+            print("BENCH " + json.dumps(r), flush=True)
+    hvd.barrier()
+
+
+def _spawn_gang(np_, env_extra, argv, timeout=600):
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.update({
+                "HVD_RANK": str(rank), "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank), "HVD_LOCAL_SIZE": str(np_),
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+            })
+            env.update(env_extra)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)] + argv,
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        outs = []
+        deadline = time.monotonic() + timeout
+        for p in procs:
+            out, err = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+            outs.append((p.returncode, out, err))
+        for rank, (code, out, err) in enumerate(outs):
+            if code != 0:
+                raise RuntimeError(
+                    f"rank {rank} exit {code}:\n{out}\n{err}")
+        return outs[0][1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def driver(args) -> None:
+    argv = ["--quick"] if args.quick else []
+    engines = ["native", "py"] if not args.engine else [args.engine]
+    cells = []
+    for engine in engines:
+        for fusion_mb in (64, 0):
+            env = {"HVD_FUSION_THRESHOLD": str(fusion_mb << 20)}
+            if engine == "py":
+                env["HVD_TPU_CORE"] = "py"
+            print(f"--- engine={engine} fusion={fusion_mb}MB "
+                  f"np={args.np} ---", flush=True)
+            out = _spawn_gang(args.np, env, argv)
+            for line in out.splitlines():
+                if line.startswith("BENCH "):
+                    r = json.loads(line[len("BENCH "):])
+                    r.update(engine=engine, fusion_mb=fusion_mb,
+                             np=args.np)
+                    cells.append(r)
+                    print("RESULT " + json.dumps(r), flush=True)
+
+    # markdown summary: fusion impact on the 64-tensor workload
+    print("\n| engine | payload | fused 64MB thr (MB/s) | "
+          "unfused (MB/s) | speedup |")
+    print("|---|---|---|---|---|")
+    by_key = {(c["engine"], c["fusion_mb"], c["bytes"]): c
+              for c in cells if c["mode"] == "grouped64"}
+    for (engine, fusion_mb, size), c in sorted(by_key.items()):
+        if fusion_mb == 0:
+            continue
+        off = by_key.get((engine, 0, size))
+        if off:
+            sp = c["alg_mb_s"] / max(off["alg_mb_s"], 1e-9)
+            print(f"| {engine} | {size >> 10} KB | {c['alg_mb_s']} | "
+                  f"{off['alg_mb_s']} | {sp:.2f}x |")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--np", type=int, default=2)
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--engine", choices=["native", "py"])
+    args = p.parse_args()
+    if os.environ.get("HVD_SIZE"):
+        worker(args)
+    else:
+        driver(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -171,6 +171,15 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                   "mpirun found on PATH (need OpenMPI or a "
                   "Hydra-family MPICH)", file=sys.stderr)
             return 2
+        if mpi_impl == mpi.MpiImpl.MPICH and (
+                args.ssh_port or args.ssh_identity_file):
+            # Statically decidable: fail before the rendezvous server
+            # and the cluster NIC probe, not after.
+            print(f"{_prog_name()}: --ssh-port/--ssh-identity-file have "
+                  "no Hydra/MPICH mapping; configure ssh via "
+                  "~/.ssh/config or use the OpenMPI or spawn launcher",
+                  file=sys.stderr)
+            return 2
     command = args.command
     if command and command[0] == "--":
         command = command[1:]
@@ -224,44 +233,35 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if args.output_filename:
         output = open(args.output_filename, "w")
     try:
-        if args.launcher == "jsrun":
-            # One jsrun fan-out: tasks get rank/size from PMIX env
-            # (discovery.from_mpi_env) and rendezvous back here; the
-            # coordinates + secret ride the process environment.
+        if args.launcher in ("jsrun", "mpirun"):
+            # One external fan-out: tasks get rank/size from the
+            # scheduler's env (PMIX_*/OMPI_*/PMI_*, discovery.
+            # from_mpi_env) and rendezvous back here.  Coordinates and
+            # the job secret ride the launcher's process environment —
+            # forwarded by NAME where the tool needs a list (-x /
+            # -genvlist) — never values on the ps-visible command line.
             import subprocess
-
-            from horovod_tpu.runner import lsf
 
             env = dict(os.environ)
             env.update(env_extra)
             env.update({"HVD_RENDEZVOUS_ADDR": addr,
                         "HVD_RENDEZVOUS_PORT": str(port)})
-            return subprocess.run(
-                lsf.jsrun_command(args.np, command), env=env,
-                stdout=output or None).returncode
-        if args.launcher == "mpirun":
-            # One mpirun fan-out (parity: run/mpi_run.py:81-158): tasks
-            # get rank/size from the OMPI_*/PMI_* env and rendezvous
-            # back here.  Env values live in the launcher's process
-            # environment and are forwarded by NAME (-x / -genvlist) —
-            # never values on the ps-visible command line.
-            import subprocess
+            if args.launcher == "jsrun":
+                from horovod_tpu.runner import lsf
 
-            from horovod_tpu.runner import mpi
+                cmd = lsf.jsrun_command(args.np, command)
+            else:  # mpirun (parity: run/mpi_run.py:81-158)
+                from horovod_tpu.runner import mpi
 
-            env = dict(os.environ)
-            env.update(env_extra)
-            env.update({"HVD_RENDEZVOUS_ADDR": addr,
-                        "HVD_RENDEZVOUS_PORT": str(port)})
-            names = sorted(set(env_extra)
-                           | {"HVD_RENDEZVOUS_ADDR",
-                              "HVD_RENDEZVOUS_PORT"})
-            cmd = mpi.mpirun_command(
-                args.np, slots, command, env_var_names=names,
-                impl=mpi_impl,
-                nics=args.nics.split(",") if args.nics else None,
-                ssh_port=args.ssh_port,
-                ssh_identity_file=args.ssh_identity_file)
+                names = sorted(set(env_extra)
+                               | {"HVD_RENDEZVOUS_ADDR",
+                                  "HVD_RENDEZVOUS_PORT"})
+                cmd = mpi.mpirun_command(
+                    args.np, slots, command, env_var_names=names,
+                    impl=mpi_impl,
+                    nics=args.nics.split(",") if args.nics else None,
+                    ssh_port=args.ssh_port,
+                    ssh_identity_file=args.ssh_identity_file)
             return subprocess.run(
                 cmd, env=env, stdout=output or None).returncode
         from horovod_tpu.runner.launch import LaunchError

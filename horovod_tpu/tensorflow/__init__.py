@@ -428,13 +428,24 @@ def DistributedOptimizer(optimizer, name=None,
                     a.assign_add(tf.convert_to_tensor(g))
             counter.assign_add(1)
 
+            # The call's None pattern is static per trace: a variable
+            # with no gradient HERE forwards None (exactly like the
+            # bpps=1 path — no zero-tensor updates that would move
+            # momentum/weight-decay state on untouched variables).  Its
+            # accumulator is left intact, applying at the next Nth pass
+            # where it does receive a gradient.
+            has_g = [g is not None for g in grads]
+
             def _apply_branch():
-                gs = [tf.convert_to_tensor(a) for a in accs]
+                gs = [tf.convert_to_tensor(a) if has else None
+                      for a, has in zip(accs, has_g)]
                 if _avg_agg:
-                    gs = [g / _bpps for g in gs]
+                    gs = [g / _bpps if g is not None else None
+                          for g in gs]
                 _reduce_apply(gs)
-                for a in accs:
-                    a.assign(tf.zeros_like(a))
+                for a, has in zip(accs, has_g):
+                    if has:
+                        a.assign(tf.zeros_like(a))
                 return tf.constant(True)
 
             def _skip_branch():

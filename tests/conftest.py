@@ -73,6 +73,12 @@ _SLOW_NODEIDS = (
     # (optimizer_accumulate now rides the 2-proc torch gang for free)
     "test_launcher_e2e.py::test_cli_four_proc",
     "test_packaging.py::test_wheel_builds_installs_and_runs",
+    # np=8 gangs: 8-process jobs are full-matrix (--runslow) material
+    "test_multiprocess.py::test_np8_gang[native]",
+    "test_multiprocess.py::test_np8_gang[py]",
+    "test_multiprocess.py::test_np8_gang[mixed]",
+    "test_multiprocess.py::test_np8_hierarchical_gang[native]",
+    "test_multiprocess.py::test_np8_hierarchical_gang[py]",
     "test_pipeline.py::test_pipeline_forward_matches_dense[4]",
     "test_pipeline.py::test_pipeline_microbatch_count",
     "test_pipeline.py::test_pipeline_train_step_matches_plain",

@@ -55,20 +55,34 @@ def scenario_allreduce():
         out.astype(np.float64), np.full(8, sum(r + 1.0 for r in range(size))))
     # fp8 wire formats (TPU-native extension): small exact values so the
     # sum is representable; mixed gangs pin native<->py codec parity.
-    for dt8 in (ml_dtypes.float8_e4m3fn, ml_dtypes.float8_e5m2):
+    # fp8 wire formats: the ring requantizes the partial sum to the wire
+    # dtype at every hop (same property as the reference's fp16
+    # compression, half.cc), so the error bound is one wire-ulp at the
+    # final magnitude per combine hop — exact at small world sizes,
+    # quantized at np=8 where partials cross coarser exponent bins.
+    def fp8_ulp(value, mant_bits):
+        import math
+
+        return 2.0 ** (math.floor(math.log2(abs(value))) - mant_bits)
+
+    for dt8, mant in ((ml_dtypes.float8_e4m3fn, 3),
+                      (ml_dtypes.float8_e5m2, 2)):
         x = np.ones(8, dt8) * (rank + 1)
         out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.{np.dtype(dt8).name}")
+        expect = sum(r + 1.0 for r in range(size))
         np.testing.assert_allclose(
-            out.astype(np.float64),
-            np.full(8, sum(r + 1.0 for r in range(size))))
+            out.astype(np.float64), np.full(8, expect),
+            atol=fp8_ulp(expect, mant) * max(size - 2, 0))
     # fp8 as compression: fp32 in, e4m3 on the wire, fp32 back.
     from horovod_tpu.ops.compression import Compression
 
     x = np.full(6, 0.25 * (rank + 1), np.float32)
+    expect = 0.25 * sum(r + 1 for r in range(size))
     out = hvd.allreduce(x, op=hvd.Sum, name="ar.fp8c",
                         compression=Compression.fp8)
     np.testing.assert_allclose(
-        out, np.full(6, 0.25 * sum(r + 1 for r in range(size))), rtol=1e-6)
+        out, np.full(6, expect, np.float32), rtol=1e-6,
+        atol=fp8_ulp(expect, 3) * max(size - 2, 0))
 
 
 def scenario_fusion():
@@ -577,8 +591,23 @@ def scenario_bridge_jit():
     g_local = np.asarray(jax.grad(loss_fn)(w))
     g_eager = np.asarray(hvd.allreduce(
         g_local, op=hvd.Average, name="br.grads.e"))
-    assert np.asarray(g_avg).tobytes() == g_eager.tobytes(), \
-        "bridge grouped grad-reduce != eager allreduce bitwise"
+    # Tolerance, not bitwise, for the train-step comparison: (a) XLA may
+    # compile the in-step gradient with different fusion/rounding than
+    # the standalone jax.grad jit, and (b) fused grouped reduction
+    # concatenates tensors, changing the ring's summation order at
+    # size>2.  The bitwise pins are the same-input checks (single above,
+    # grouped below).
+    np.testing.assert_allclose(np.asarray(g_avg), g_eager, rtol=1e-5)
+    ga = g_local.copy()
+    gb = (g_local * 0.5).astype(np.float32)
+    out_j = [np.asarray(v) for v in jax.jit(
+        lambda t, u: hvd.grouped_allreduce([t, u], op=hvd.Average,
+                                           name="br.grp"))(ga, gb)]
+    out_e = hvd.grouped_allreduce([ga, gb], op=hvd.Average,
+                                  name="br.grp.e")
+    assert out_j[0].tobytes() == np.asarray(out_e[0]).tobytes(), \
+        "bridge grouped != eager grouped bitwise (same inputs)"
+    assert out_j[1].tobytes() == np.asarray(out_e[1]).tobytes()
     np.testing.assert_allclose(np.asarray(g2_avg), 2 * g_eager, rtol=1e-6)
     np.testing.assert_allclose(
         np.asarray(w2), np.asarray(w) - 0.01 * g_eager, rtol=1e-6)
@@ -754,6 +783,58 @@ def scenario_autotune():
             expect = np.full(
                 1024, sum(r + 1.0 + i for r in range(size)), np.float32)
             np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def scenario_autotune_converges():
+    """The tuner must settle in the measured-best region of a real
+    surface: with dozens of small tensors per step, fused wire traffic
+    beats unfused by a wide measured margin on this box
+    (examples/engine_benchmark.py: 1.5-7x), so after sampling, the
+    settled fusion threshold must be in the fused (>=1 MiB) region and
+    the sample log must score the fused region above the unfused one
+    (parity: parameter_manager.cc:89-181 bytes/s scoring).  The test
+    env pins cycle/cache so fusion is the only tuned dimension."""
+    rank, size = hvd.rank(), hvd.size()
+    log = os.environ.get("HVD_AUTOTUNE_LOG")  # written by rank 0
+    k = 48
+    flag = np.zeros(1, np.float32)
+    for step in range(600):
+        handles = [hvd.allreduce_async(
+            np.full(512, rank + 1.0 + i, np.float32),
+            name=f"atc.t{i}", op=hvd.Sum) for i in range(k)]
+        for h in handles:
+            hvd.synchronize(h)
+        done = 0.0
+        if rank == 0 and log and os.path.exists(log):
+            with open(log) as f:
+                if "final" in f.read():
+                    done = 1.0
+        flag = hvd.allreduce(np.array([done], np.float32), op=hvd.Sum,
+                             name="atc.done")
+        if flag[0] > 0:
+            break
+    assert flag[0] > 0, "autotuner did not settle within the step budget"
+    if rank == 0:
+        with open(log) as f:
+            rows = [ln.strip().split(",")
+                    for ln in f.read().strip().splitlines()]
+        header, data = rows[0], rows[1:]
+        fus_i = header.index("fusion_threshold")
+        score_i = header.index("score_bytes_per_s")
+        samples = [r for r in data if r[0] != "final"]
+        finals = [r for r in data if r[0] == "final"]
+        assert finals, data
+        settled = int(finals[-1][fus_i])
+        assert settled >= (1 << 20), \
+            f"settled on unfused threshold {settled} " \
+            f"against a measured fused-is-faster surface:\n{data}"
+        fused = [float(r[score_i]) for r in samples
+                 if int(r[fus_i]) >= (1 << 20)]
+        unfused = [float(r[score_i]) for r in samples
+                   if int(r[fus_i]) < (1 << 20)]
+        if fused and unfused:
+            # the measured surface itself must rank fused above unfused
+            assert max(fused) > max(unfused), (fused, unfused)
 
 
 def scenario_cache_disabled():

@@ -105,6 +105,28 @@ class TestParameterManager:
         assert "cycle" in pm._dims
 
 
+@pytest.mark.parametrize("engine", ENGINES)
+def test_autotune_converges_to_measured_optimum(engine, tmp_path):
+    """Against a real throughput surface (48 small tensors/step, where
+    fusion measurably wins on this box — examples/engine_benchmark.py),
+    the tuner must settle in the fused region, scored by actual bytes/s
+    (parity: parameter_manager.cc:89-181).  Cycle/cache are env-pinned
+    so fusion is the only tuned dimension."""
+    log = str(tmp_path / f"atc_{engine}.csv")
+    run_workers("autotune_converges", 2, engine=engine, timeout=300.0,
+                extra_env={
+                    "HVD_AUTOTUNE": "1",
+                    "HVD_AUTOTUNE_WARMUP_SAMPLES": "2",
+                    "HVD_AUTOTUNE_MAX_SAMPLES": "8",
+                    "HVD_AUTOTUNE_SAMPLE_DURATION_SECONDS": "0.15",
+                    "HVD_AUTOTUNE_LOG": log,
+                    "HVD_CYCLE_TIME": "5",
+                    "HVD_CACHE_CAPACITY": "2048",
+                })
+    content = open(log).read()
+    assert "final" in content
+
+
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 def test_autotune_end_to_end(engine, tmp_path):
     log = str(tmp_path / f"at_{engine}.csv")

@@ -1,0 +1,144 @@
+"""SIMD wire-codec combine: bit-exactness vs the scalar reference and a
+speedup floor.
+
+Parity target: ``horovod/common/half.cc:43-77`` — the reference
+hand-vectorizes the fp16 fused sum with F16C/AVX because the per-hop
+decode→accumulate→encode loop is the hot path of compressed wire
+traffic.  Here the native core carries F16C fp16, AVX2 bf16, and exact
+256×256 pairwise tables for the fp8 formats, runtime-gated on CPU
+support and on ``HVD_NO_SIMD=1`` (the scalar baseline used below).
+Measured on the dev box (see docs/benchmarks.md): fp16 53→3275 Melem/s
+(61×), bf16 180→1909 (10.6×), fp8 e4m3 55→643 (11.7×), e5m2 51→511
+(10×).
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "horovod_tpu", "_lib", "libhvd_core.so")
+
+# (name, DataType enum, numpy dtype string via ml_dtypes where needed)
+DTYPES = [("fp16", 6, "float16"), ("bf16", 10, "bfloat16"),
+          ("fp8_e4m3", 11, "float8_e4m3fn"), ("fp8_e5m2", 12,
+                                              "float8_e5m2")]
+OPS = [("sum", 1), ("min", 3), ("max", 4), ("product", 5)]
+
+_CHILD = r"""
+import ctypes, sys, numpy as np, ml_dtypes
+lib = ctypes.CDLL(sys.argv[1])
+lib.hvd_combine_into.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_uint64, ctypes.c_int,
+                                 ctypes.c_int]
+dt_enum, op, np_name, n, seed = (int(sys.argv[2]), int(sys.argv[3]),
+                                 sys.argv[4], int(sys.argv[5]),
+                                 int(sys.argv[6]))
+dt = np.dtype(getattr(ml_dtypes, np_name, None) or np_name)
+rs = np.random.RandomState(seed)
+raw_a = rs.randint(0, 256, n * dt.itemsize).astype(np.uint8)
+raw_b = rs.randint(0, 256, n * dt.itemsize).astype(np.uint8)
+dst = raw_a.copy()
+lib.hvd_combine_into(dst.ctypes.data, raw_b.ctypes.data, n, dt_enum, op)
+sys.stdout.buffer.write(dst.tobytes())
+"""
+
+
+def _combine_in_child(no_simd, dt_enum, op, np_name, n, seed):
+    env = dict(os.environ, HVD_NO_SIMD="1" if no_simd else "0")
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD, LIB, str(dt_enum), str(op),
+         np_name, str(n), str(seed)],
+        capture_output=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr.decode()
+    return r.stdout
+
+
+@pytest.mark.parametrize("name,dt_enum,np_name", DTYPES)
+@pytest.mark.parametrize("op_name,op", OPS)
+def test_simd_combine_bit_exact(name, dt_enum, np_name, op_name, op):
+    """Every SIMD path must produce the scalar path's bytes exactly —
+    random bit patterns (including NaN/inf encodings for the fp8
+    formats, whose pairwise tables are exact over the whole 256x256
+    domain by construction)."""
+    if not os.path.exists(LIB):
+        pytest.skip("native core not built")
+    import ml_dtypes
+
+    # 1031: odd length exercises the vector tail
+    fast = _combine_in_child(False, dt_enum, op, np_name, 1031, 5)
+    slow = _combine_in_child(True, dt_enum, op, np_name, 1031, 5)
+    # The engine's cross-path contract is VALUE equality: NaN sign and
+    # payload are unspecified (they differ between hardware F16C, the
+    # compiler's autovectorized table build, and numpy on the py
+    # engine), and min/max may pick either signed zero.  Any rounding
+    # divergence still fails — it changes the decoded value.
+    dt = np.dtype(getattr(ml_dtypes, np_name, None) or np_name)
+    a = np.frombuffer(fast, dtype=dt).astype(np.float32)
+    b = np.frombuffer(slow, dtype=dt).astype(np.float32)
+    # Lanes with a NaN *input* are excluded for min/max: std::min,
+    # _mm256_min_ps, and numpy's minimum each pick a different operand
+    # there — behavior the engine contract already leaves unspecified
+    # (the numpy py engine diverges from the scalar C++ too).
+    rs = np.random.RandomState(5)
+    in_a = np.frombuffer(
+        rs.randint(0, 256, 1031 * dt.itemsize).astype(np.uint8)
+        .tobytes(), dtype=dt).astype(np.float32)
+    in_b = np.frombuffer(
+        rs.randint(0, 256, 1031 * dt.itemsize).astype(np.uint8)
+        .tobytes(), dtype=dt).astype(np.float32)
+    ok = ~(np.isnan(in_a) | np.isnan(in_b)) if op_name in ("min", "max") \
+        else np.ones(1031, bool)
+    np.testing.assert_array_equal(np.isnan(a[ok]), np.isnan(b[ok]))
+    ok &= ~np.isnan(a)
+    np.testing.assert_array_equal(a[ok], b[ok],
+                                  err_msg=f"{name}/{op_name}")
+
+
+def test_simd_combine_speedup():
+    """The vectorized hot loop must beat the scalar baseline clearly
+    (>=2x asserted as a conservative floor for loaded CI boxes; the
+    measured dev-box numbers are 10-61x, recorded in the module
+    docstring and docs/benchmarks.md)."""
+    if not os.path.exists(LIB):
+        pytest.skip("native core not built")
+    bench = r"""
+import ctypes, sys, json
+lib = ctypes.CDLL(sys.argv[1])
+lib.hvd_bench_combine.restype = ctypes.c_double
+lib.hvd_bench_combine.argtypes = [ctypes.c_int, ctypes.c_uint64,
+                                  ctypes.c_int]
+out = {}
+for name, dt in (("fp16", 6), ("bf16", 10), ("fp8_e4m3", 11),
+                 ("fp8_e5m2", 12)):
+    out[name] = lib.hvd_bench_combine(dt, 1 << 19, 20)
+print(json.dumps(out))
+"""
+
+    def run(no_simd):
+        env = dict(os.environ, HVD_NO_SIMD="1" if no_simd else "0")
+        r = subprocess.run([sys.executable, "-c", bench, LIB],
+                           capture_output=True, text=True, env=env,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr
+        return json.loads(r.stdout)
+
+    # SIMD may be unavailable on this CPU (non-x86 or no AVX2/F16C):
+    # then both runs are scalar and the test degenerates to a no-op.
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "print(__import__('platform').machine())"],
+        capture_output=True, text=True).stdout.strip()
+    fast, slow = run(False), run(True)
+    if probe not in ("x86_64", "AMD64"):
+        pytest.skip(f"no SIMD path on {probe}")
+    for name in fast:
+        speedup = fast[name] / max(slow[name], 1e-9)
+        assert speedup >= 2.0, (
+            f"{name}: {speedup:.2f}x (fast {fast[name]/1e6:.0f} vs "
+            f"scalar {slow[name]/1e6:.0f} Melem/s)")
