@@ -4,12 +4,15 @@ The eager engine's analog of the reference's NCCL bandwidth sweeps and
 the surface its autotuner actually scores (bytes/s per sample window,
 ``parameter_manager.cc:89-181``).  Two modes:
 
-* **driver** (default, no ``HVD_SIZE`` in env): spawns its own N-rank
+* **driver** (default, no ``HVD_SIZE`` in env): launches an N-rank
   gang per configuration — engine {native, py} × fusion {on, off} —
-  collects every rank-0 JSON line, and prints a markdown table plus
-  one ``RESULT {...}`` JSON line per cell.
+  through the framework's own run-func mode
+  (``horovod_tpu.runner.run.run``: rendezvous, HMAC secret, teardown
+  all come from the real launcher, and per-rank results return as
+  values), then prints a markdown table plus one ``RESULT {...}``
+  JSON line per cell.
 
-* **worker** (``HVD_SIZE`` set — i.e. under ``hvdrun`` or the driver):
+* **worker** (``HVD_SIZE`` set — i.e. under ``hvdrun``):
   times two workloads over the live mesh:
 
   1. *bandwidth sweep*: one tensor per step, 64 KB → 64 MB, wire dtype
@@ -36,7 +39,6 @@ or a single configuration under the launcher::
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -55,12 +57,14 @@ def _wire_dtypes():
             ("fp8", Compression.fp8, np.float32)]
 
 
-def worker(args) -> None:
+def bench_workloads(quick: bool):
+    """Runs on every rank of a live gang; returns the result rows
+    (rank 0's copy is authoritative — all ranks measure identically)."""
     import horovod_tpu as hvd
 
     hvd.init()
     rank, n = hvd.rank(), hvd.size()
-    sizes = ([1 << 16, 1 << 20] if args.quick
+    sizes = ([1 << 16, 1 << 20] if quick
              else [1 << 16, 1 << 18, 1 << 20, 1 << 23, 1 << 26])
     results = []
 
@@ -115,70 +119,40 @@ def worker(args) -> None:
                             bus_mb_s=round(bus, 1),
                             ms_per_op=round(ms, 3)))
 
-    if rank == 0:
-        for r in results:
+    return results
+
+
+def worker(args) -> None:
+    import horovod_tpu as hvd
+
+    rows = bench_workloads(args.quick)
+    if hvd.rank() == 0:
+        for r in rows:
             print("BENCH " + json.dumps(r), flush=True)
-    hvd.barrier()
-
-
-def _spawn_gang(np_, env_extra, argv, timeout=600):
-    from horovod_tpu.runner.http_server import RendezvousServer
-
-    server = RendezvousServer("127.0.0.1")
-    port = server.start()
-    procs = []
-    try:
-        for rank in range(np_):
-            env = dict(os.environ)
-            env.update({
-                "HVD_RANK": str(rank), "HVD_SIZE": str(np_),
-                "HVD_LOCAL_RANK": str(rank), "HVD_LOCAL_SIZE": str(np_),
-                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
-                "HVD_RENDEZVOUS_PORT": str(port),
-                "JAX_PLATFORMS": "cpu",
-            })
-            env.update(env_extra)
-            procs.append(subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__)] + argv,
-                env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE, text=True))
-        outs = []
-        deadline = time.monotonic() + timeout
-        for p in procs:
-            out, err = p.communicate(
-                timeout=max(1.0, deadline - time.monotonic()))
-            outs.append((p.returncode, out, err))
-        for rank, (code, out, err) in enumerate(outs):
-            if code != 0:
-                raise RuntimeError(
-                    f"rank {rank} exit {code}:\n{out}\n{err}")
-        return outs[0][1]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        server.stop()
 
 
 def driver(args) -> None:
-    argv = ["--quick"] if args.quick else []
+    # The gangs go through the framework's own run-func mode — one
+    # launch path to maintain, with rendezvous, job secret, env
+    # propagation, and teardown handled by the real launcher.
+    from horovod_tpu.runner.run import run as hvd_run
+
     engines = ["native", "py"] if not args.engine else [args.engine]
     cells = []
     for engine in engines:
         for fusion_mb in (64, 0):
-            env = {"HVD_FUSION_THRESHOLD": str(fusion_mb << 20)}
+            env = {"HVD_FUSION_THRESHOLD": str(fusion_mb << 20),
+                   "JAX_PLATFORMS": "cpu"}
             if engine == "py":
                 env["HVD_TPU_CORE"] = "py"
             print(f"--- engine={engine} fusion={fusion_mb}MB "
                   f"np={args.np} ---", flush=True)
-            out = _spawn_gang(args.np, env, argv)
-            for line in out.splitlines():
-                if line.startswith("BENCH "):
-                    r = json.loads(line[len("BENCH "):])
-                    r.update(engine=engine, fusion_mb=fusion_mb,
-                             np=args.np)
-                    cells.append(r)
-                    print("RESULT " + json.dumps(r), flush=True)
+            per_rank = hvd_run(bench_workloads, (args.quick,),
+                               np=args.np, env=env)
+            for r in per_rank[0]:
+                r.update(engine=engine, fusion_mb=fusion_mb, np=args.np)
+                cells.append(r)
+                print("RESULT " + json.dumps(r), flush=True)
 
     # markdown summary: fusion impact on the 64-tensor workload
     print("\n| engine | payload | fused 64MB thr (MB/s) | "

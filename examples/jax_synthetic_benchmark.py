@@ -40,6 +40,11 @@ def parse_args():
     p.add_argument("--num-iters", type=int, default=10)
     p.add_argument("--fp16-allreduce", action="store_true",
                    help="compress gradient allreduce to 16 bit")
+    p.add_argument("--bridge", action="store_true",
+                   help="multi-process mode: jit the WHOLE train step; "
+                        "the gradient reduction rides the engine via "
+                        "the host-callback bridge (ops/bridge.py) "
+                        "instead of eager op-by-op dispatch")
     p.add_argument("--image-size", type=int, default=0,
                    help="override input resolution (0 = 224, or 32 for "
                         "--model tiny)")
@@ -145,13 +150,21 @@ def run_eager(args):
 
     def one_batch(params):
         grads = grad_fn(params, bstats, images, labels)
-        # axis=None selects the eager multi-process allreduce path.
+        # axis=None selects the engine (multi-process) allreduce path;
+        # under jit the sync ops dispatch through the bridge.
         grads = hvd.allreduce_gradients(grads, axis=None,
                                         compression=compression)
         return jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
 
+    if args.bridge:
+        # Whole-step jit: XLA fuses grad + update, and the reduction
+        # enters the engine via one ordered host callback (fusion,
+        # cache, timeline on the compiled path).
+        one_batch = jax.jit(one_batch)
+
     log(rank, f"Model: {args.model}  Batch size: {args.batch_size} "
-              f"x {nproc} process(es), eager mode")
+              f"x {nproc} process(es), "
+              f"{'bridge (jitted step)' if args.bridge else 'eager'} mode")
     for _ in range(args.num_warmup_batches):
         params = one_batch(params)
     jax.block_until_ready(params)

@@ -53,6 +53,14 @@ def _allreduce_grads_eager(grads, op, compression):
     from horovod_tpu.ops import eager
 
     leaves, treedef = jax.tree.flatten(grads)
+    if any(eager._is_traced(g) for g in leaves):
+        # Inside jit: one host callback enqueues the whole group into
+        # the engine (controller fusion on the compiled path) — the
+        # bridge regime, ops/bridge.py.
+        from horovod_tpu.ops import bridge
+
+        return jax.tree.unflatten(treedef, list(bridge.grouped_allreduce(
+            tuple(leaves), name="grad", op=op, compression=compression)))
     handles = []
     for i, g in enumerate(leaves):
         handles.append(eager.allreduce_async(

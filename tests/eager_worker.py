@@ -70,9 +70,11 @@ def scenario_allreduce():
         x = np.ones(8, dt8) * (rank + 1)
         out = hvd.allreduce(x, op=hvd.Sum, name=f"ar.{np.dtype(dt8).name}")
         expect = sum(r + 1.0 for r in range(size))
+        # RNE contributes at most half a wire-ulp per combine hop
+        # (size-2 re-quantized partial sums after the first add).
         np.testing.assert_allclose(
             out.astype(np.float64), np.full(8, expect),
-            atol=fp8_ulp(expect, mant) * max(size - 2, 0))
+            atol=0.5 * fp8_ulp(expect, mant) * max(size - 2, 0))
     # fp8 as compression: fp32 in, e4m3 on the wire, fp32 back.
     from horovod_tpu.ops.compression import Compression
 
@@ -82,7 +84,7 @@ def scenario_allreduce():
                         compression=Compression.fp8)
     np.testing.assert_allclose(
         out, np.full(6, expect, np.float32), rtol=1e-6,
-        atol=fp8_ulp(expect, 3) * max(size - 2, 0))
+        atol=0.5 * fp8_ulp(expect, 3) * max(size - 2, 0))
 
 
 def scenario_fusion():

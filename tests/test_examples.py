@@ -92,6 +92,18 @@ def test_jax_synthetic_benchmark_2proc_fp16():
     assert "Total img/sec on 2 device(s)" in out
 
 
+def test_jax_synthetic_benchmark_2proc_bridge():
+    # The jitted-step regime: the gradient reduction rides the engine
+    # through the host-callback bridge (ops/bridge.py).
+    out = run_example(
+        "jax_synthetic_benchmark.py", 2,
+        ["--model", "tiny", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2", "--bridge"])
+    assert "bridge (jitted step) mode" in out
+    assert "Total img/sec on 2 device(s)" in out
+
+
 def test_tensorflow2_mnist_2proc():
     pytest.importorskip("tensorflow")
     out = run_example("tensorflow2_mnist.py", 2,

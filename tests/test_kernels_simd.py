@@ -128,15 +128,17 @@ print(json.dumps(out))
         assert r.returncode == 0, r.stderr
         return json.loads(r.stdout)
 
-    # SIMD may be unavailable on this CPU (non-x86 or no AVX2/F16C):
-    # then both runs are scalar and the test degenerates to a no-op.
-    probe = subprocess.run(
-        [sys.executable, "-c",
-         "print(__import__('platform').machine())"],
-        capture_output=True, text=True).stdout.strip()
+    # Feature-level gate, before the expensive runs: without AVX2+F16C
+    # (non-x86, QEMU's default CPU model, pre-Haswell) the fp16/bf16
+    # fast paths do not engage and no speedup exists to assert.
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = f.read()
+        if "avx2" not in flags or "f16c" not in flags:
+            pytest.skip("CPU lacks AVX2/F16C; fast paths disabled")
+    except OSError:
+        pytest.skip("cannot probe CPU features")
     fast, slow = run(False), run(True)
-    if probe not in ("x86_64", "AMD64"):
-        pytest.skip(f"no SIMD path on {probe}")
     for name in fast:
         speedup = fast[name] / max(slow[name], 1e-9)
         assert speedup >= 2.0, (
