@@ -192,11 +192,17 @@ class TorchEstimator(HorovodEstimator):
     callable ``(pred, target) -> scalar tensor``.
     """
 
-    def __init__(self, model, optimizer=None, loss=None, **kw):
+    def __init__(self, model, optimizer=None, loss=None,
+                 classification=None, **kw):
+        """``classification``: force (True/False) the index-target
+        coercion for single-column labels; default None auto-detects
+        CrossEntropyLoss/NLLLoss instances — pass True for functional
+        or custom index-target losses."""
         super().__init__(**kw)
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
+        self.classification = classification
 
     def fit(self, df) -> "TorchModel":
         import torch
@@ -214,6 +220,7 @@ class TorchEstimator(HorovodEstimator):
         store, feature_cols, label_cols = (
             self.store, self.feature_cols, self.label_cols)
         batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
+        classification = self.classification
 
         def build(run_id):
             def _train():
@@ -224,6 +231,16 @@ class TorchEstimator(HorovodEstimator):
                 rank, size = hvd.rank(), hvd.size()
                 X, y = read_shard(store, run_id, rank, size,
                                   feature_cols, label_cols)
+                # Classification losses take 1-D class indices; the
+                # parquet shards carry labels as float32 matrices
+                # (parity: the reference feeds NLLLoss int targets in
+                # examples/pytorch_spark_mnist.py).  Only single-column
+                # labels coerce — multi-column targets (one-hot / soft
+                # labels) stay (B, C) float for CE's soft-target mode.
+                classify = classification if classification is not None \
+                    else isinstance(loss_fn, (torch.nn.CrossEntropyLoss,
+                                              torch.nn.NLLLoss))
+                classify = classify and y.shape[1] == 1
                 local = copy.deepcopy(model)
                 dist_opt = hvd.DistributedOptimizer(
                     opt_builder(local.parameters()),
@@ -238,6 +255,8 @@ class TorchEstimator(HorovodEstimator):
                         idx = perm[i:i + batch_size]
                         xb = torch.from_numpy(X[idx])
                         yb = torch.from_numpy(y[idx])
+                        if classify:
+                            yb = yb.reshape(-1).long()
                         dist_opt.zero_grad()
                         out = local(xb)
                         l = loss_fn(out, yb)

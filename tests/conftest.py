@@ -57,6 +57,8 @@ _SLOW_NODEIDS = (
     # sparse path), pytorch_mnist_2proc (torch front-end), spark
     # torch-estimator fit, mxnet gate checks
     "test_examples.py::test_jax_mnist_2proc",
+    "test_examples.py::test_pytorch_spark_mnist_example",
+    "test_examples.py::test_keras_spark_mnist_example",
     "test_examples.py::test_pytorch_imagenet_resnet50_2proc",
     "test_examples.py::test_scaling_benchmark_virtual_mesh",
     "test_examples.py::test_jax_transformer_lm_3axis",

@@ -92,6 +92,23 @@ def test_jax_synthetic_benchmark_2proc_fp16():
     assert "Total img/sec on 2 device(s)" in out
 
 
+def test_pytorch_spark_mnist_example():
+    # Estimator workflow end-to-end (ref examples/pytorch_spark_mnist.py):
+    # DataFrame -> TorchEstimator.fit (2 ranks) -> predict.
+    pytest.importorskip("torch")
+    out = run_example("pytorch_spark_mnist.py", 1,
+                      ["--num-proc", "2", "--epochs", "1"], timeout=420)
+    assert "DONE" in out
+
+
+def test_keras_spark_mnist_example():
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    out = run_example("keras_spark_mnist.py", 1,
+                      ["--num-proc", "2", "--epochs", "1"], timeout=420)
+    assert "DONE" in out
+
+
 def test_jax_synthetic_benchmark_2proc_bridge():
     # The jitted-step regime: the gradient reduction rides the engine
     # through the host-callback bridge (ops/bridge.py).
