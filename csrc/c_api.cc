@@ -106,6 +106,10 @@ void hvd_shutdown() {
 
 int hvd_is_aborted() { return g_engine && g_engine->aborted() ? 1 : 0; }
 
+// Raw engine pointer for in-process native consumers (the XLA FFI
+// handlers in ffi_bridge.cc); NULL before init / after shutdown.
+void* hvd_engine_handle() { return g_engine.get(); }
+
 const char* hvd_last_error() { return g_last_error.c_str(); }
 
 int64_t hvd_register_process_set(int id, const int32_t* ranks, int n) {

@@ -1,0 +1,191 @@
+// XLA FFI custom-call handlers: compiled programs enqueue into the
+// native engine with NO Python on the hot path.
+//
+// Role parity: the reference's framework custom ops
+// (tensorflow/mpi_ops.cc:287-320 HorovodAllreduceOp::ComputeAsync ->
+// EnqueueTensorAllreduce) — an op registered with the framework's
+// compiler/executor whose kernel body hands the buffer to the shared
+// background coordinator.  Here the op is an XLA custom call built with
+// the FFI headers jaxlib ships; `horovod_tpu/ops/bridge.py` registers
+// it for the CPU platform and prefers it over the io_callback path when
+// the native engine is live (TPU executions keep the host-callback
+// path — TPU has no user custom-call mechanism, so XLA stages the
+// transfer instead).
+//
+// One GROUPED handler covers both shapes of use (a single tensor is a
+// group of one): every operand is copied into its XLA result buffer,
+// all are enqueued asynchronously under `{name}.{i}`, then all are
+// awaited — the controller sees the whole group outstanding and fuses
+// (fusion_buffer_manager parity), and one blocking call per step keeps
+// the CPU thunk executor deadlock-free by construction.
+//
+// Compiled only when the jaxlib FFI headers are present
+// (-DHVD_HAVE_XLA_FFI, see Makefile / setup.py); the engine core never
+// depends on them.
+
+#ifdef HVD_HAVE_XLA_FFI
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "xla/ffi/api/ffi.h"
+
+extern "C" void* hvd_engine_handle();
+
+namespace {
+
+namespace ffi = xla::ffi;
+
+bool MapDtype(ffi::DataType in, hvd::DataType* out) {
+  switch (in) {
+    case ffi::DataType::F32:
+      *out = hvd::DataType::FLOAT32;
+      return true;
+    case ffi::DataType::F64:
+      *out = hvd::DataType::FLOAT64;
+      return true;
+    case ffi::DataType::F16:
+      *out = hvd::DataType::FLOAT16;
+      return true;
+    case ffi::DataType::BF16:
+      *out = hvd::DataType::BFLOAT16;
+      return true;
+    case ffi::DataType::F8E4M3FN:
+      *out = hvd::DataType::FLOAT8_E4M3;
+      return true;
+    case ffi::DataType::F8E5M2:
+      *out = hvd::DataType::FLOAT8_E5M2;
+      return true;
+    case ffi::DataType::S8:
+      *out = hvd::DataType::INT8;
+      return true;
+    case ffi::DataType::U8:
+      *out = hvd::DataType::UINT8;
+      return true;
+    case ffi::DataType::S16:
+      *out = hvd::DataType::INT16;
+      return true;
+    case ffi::DataType::U16:
+      *out = hvd::DataType::UINT16;
+      return true;
+    case ffi::DataType::S32:
+      *out = hvd::DataType::INT32;
+      return true;
+    case ffi::DataType::S64:
+      *out = hvd::DataType::INT64;
+      return true;
+    case ffi::DataType::PRED:
+      *out = hvd::DataType::BOOL;
+      return true;
+    default:
+      return false;
+  }
+}
+
+ffi::Error GroupedAllreduceImpl(ffi::RemainingArgs args,
+                                ffi::RemainingRets rets,
+                                std::string_view name, int32_t op,
+                                double prescale, double postscale,
+                                int32_t ps_id, int32_t ps_size,
+                                int32_t single) {
+  // Lifetime: identical contract to the ctypes surface (hvd_wait et
+  // al.) — Engine::Shutdown drains the background loop and marks every
+  // pending handle ABORTED before hvd_shutdown() releases the object,
+  // so a handler blocked in Wait() is woken with a status, not freed
+  // from under.  Shutting down mid-execution is a caller error in both
+  // regimes; the drain turns it into a clean ABORTED.
+  auto* eng = static_cast<hvd::Engine*>(hvd_engine_handle());
+  if (eng == nullptr) {
+    return ffi::Error(ffi::ErrorCode::kFailedPrecondition,
+                      "horovod_tpu native engine is not initialized");
+  }
+  const size_t n = args.size();
+  if (rets.size() != n) {
+    return ffi::Error(ffi::ErrorCode::kInvalidArgument,
+                      "operand/result arity mismatch");
+  }
+  const std::string base(name);
+  std::vector<int64_t> handles;
+  handles.reserve(n);
+
+  auto fail = [&](const std::string& msg) {
+    // Await anything already enqueued — the engine owns those buffers
+    // until completion, and peers may already be mid-negotiation.
+    for (int64_t h : handles) {
+      eng->handles().Wait(h);
+      eng->handles().Release(h);
+    }
+    return ffi::Error(ffi::ErrorCode::kInternal, msg);
+  };
+
+  for (size_t i = 0; i < n; ++i) {
+    auto arg = args.get<ffi::AnyBuffer>(i);
+    auto ret = rets.get<ffi::AnyBuffer>(i);
+    if (!arg.has_value() || !ret.has_value()) {
+      return fail("FFI buffer decode failed");
+    }
+    ffi::AnyBuffer in = arg.value();
+    ffi::AnyBuffer out = *ret.value();
+    hvd::DataType dt;
+    if (!MapDtype(in.element_type(), &dt)) {
+      return fail("unsupported dtype for engine allreduce");
+    }
+    if (out.size_bytes() != in.size_bytes()) {
+      return fail("result size mismatch");
+    }
+    // The engine reduces allreduce buffers in place: stage the operand
+    // into the XLA result allocation and hand that to the ring.
+    std::memcpy(out.untyped_data(), in.untyped_data(), in.size_bytes());
+    hvd::TensorShape shape;
+    for (int64_t d : in.dimensions()) shape.dims.push_back(d);
+    std::string err;
+    // `single`: a lone hvd.allreduce keeps its unsuffixed name so the
+    // wire name matches an io_callback/eager rank in a mixed gang;
+    // grouped entries suffix `.{i}` exactly like the Python surface.
+    std::string tensor_name =
+        (single != 0 && n == 1) ? base : base + "." + std::to_string(i);
+    int64_t h = eng->EnqueueAllreduce(
+        tensor_name, out.untyped_data(), shape, dt,
+        static_cast<hvd::ReduceOp>(op), prescale, postscale, &err, ps_id,
+        ps_size);
+    if (h < 0) {
+      return fail("enqueue failed: " + err);
+    }
+    handles.push_back(h);
+  }
+
+  std::string first_error;
+  for (int64_t h : handles) {
+    hvd::StatusType st = eng->handles().Wait(h);
+    if (st != hvd::StatusType::OK && first_error.empty()) {
+      auto* state = eng->handles().Get(h);
+      first_error = state != nullptr && !state->status.reason.empty()
+                        ? state->status.reason
+                        : "collective failed";
+    }
+    eng->handles().Release(h);
+  }
+  if (!first_error.empty()) {
+    return ffi::Error(ffi::ErrorCode::kInternal, first_error);
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    HvdGroupedAllreduce, GroupedAllreduceImpl,
+    ffi::Ffi::Bind()
+        .RemainingArgs()
+        .RemainingRets()
+        .Attr<std::string_view>("name")
+        .Attr<int32_t>("op")
+        .Attr<double>("prescale")
+        .Attr<double>("postscale")
+        .Attr<int32_t>("ps_id")
+        .Attr<int32_t>("ps_size")
+        .Attr<int32_t>("single"));
+
+#endif  // HVD_HAVE_XLA_FFI

@@ -145,6 +145,89 @@ def _group_index(process_set) -> int:
 
 
 # ---------------------------------------------------------------------------
+# native XLA custom-call fast path (CPU platform + native engine)
+#
+# ffi_bridge.cc registers an FFI handler that enqueues straight into the
+# C++ engine — no Python in the compiled program's hot loop (the exact
+# mechanism of the reference's registered framework op,
+# tensorflow/mpi_ops.cc:287-320).  TPU executions keep io_callback (TPU
+# has no user custom-call surface; XLA stages the host transfer).
+
+# Only the REGISTRATION is cached; engine/backend checks re-derive per
+# trace so a shutdown()/init() cycle (possibly onto the py engine, whose
+# process has no live C++ Engine) can never route to a stale handler.
+_ffi_state = {"registered": None}
+
+# dtypes the handler's MapDtype accepts (ffi_bridge.cc)
+_FFI_DTYPES = ("float32", "float64", "float16", "bfloat16",
+               "float8_e4m3fn", "float8_e5m2", "int8", "uint8", "int16",
+               "uint16", "int32", "int64", "bool")
+
+
+def _native_ffi_ready() -> bool:
+    import os
+
+    if os.environ.get("HVD_NO_FFI_BRIDGE") == "1":
+        return False
+    try:
+        import jax
+
+        from horovod_tpu.runtime_native import NativeEngine
+
+        if not isinstance(basics._engine(), NativeEngine):
+            return False
+        if jax.default_backend() != "cpu":
+            return False
+    except Exception:
+        return False
+    if _ffi_state["registered"] is None:
+        _ffi_state["registered"] = False
+        try:
+            from horovod_tpu import native
+
+            lib = native.load()
+            handler = getattr(lib, "HvdGroupedAllreduce", None)
+            if handler is not None:
+                jax.ffi.register_ffi_target(
+                    "hvd_grouped_allreduce",
+                    jax.ffi.pycapsule(handler), platform="cpu")
+                _ffi_state["registered"] = True
+        except Exception:
+            _ffi_state["registered"] = False
+    return _ffi_state["registered"]
+
+
+def _ffi_eligible(leaves, compression) -> bool:
+    from horovod_tpu.ops.compression import Compression
+
+    if compression is not None and compression is not Compression.none:
+        # wire compression casts host-side — io_callback path
+        return False
+    if not all(str(l.dtype) in _FFI_DTYPES for l in leaves):
+        return False
+    return _native_ffi_ready()
+
+
+def _ffi_grouped_call(leaves, base, op, prescale, postscale, process_set):
+    import jax
+
+    ps_id, ps_size = 0, 0
+    if process_set is not None:
+        ps_id, ps_size = process_set.validate(basics.rank(), basics.size())
+    call = jax.ffi.ffi_call(
+        "hvd_grouped_allreduce",
+        tuple(_spec_like(l) for l in leaves),
+        has_side_effect=True)
+    # `single=0`: grouped entries wire-name as `{base}.{i}`, identical
+    # to the io_callback/eager grouped surface (mixed gangs align).
+    return call(*leaves, name=base, op=np.int32(int(op)),
+                prescale=np.float64(prescale),
+                postscale=np.float64(postscale),
+                ps_id=np.int32(ps_id), ps_size=np.int32(ps_size),
+                single=np.int32(0))
+
+
+# ---------------------------------------------------------------------------
 # allreduce
 
 
@@ -186,6 +269,13 @@ def allreduce(x, name: Optional[str] = None,
 
 def _allreduce_call(x, name, op, prescale, postscale, compression,
                     process_set):
+    # Single-tensor calls stay on the ORDERED host callback even when
+    # the native custom call is available: a program with several
+    # independent blocking collectives relies on identical cross-rank
+    # submission order, which only the ordered-effects path guarantees
+    # (XLA may schedule plain custom calls in any data-flow-consistent
+    # order).  The FFI fast path serves grouped_allreduce, where every
+    # tensor is enqueued before any wait inside ONE call.
     return _io_callback(
         partial(_host_allreduce, name, op, prescale, postscale,
                 compression, process_set),
@@ -277,6 +367,16 @@ def grouped_allreduce(tensors, name: Optional[str] = None,
 
 
 def _grouped_call(leaves, base, op, compression, process_set):
+    # Native custom call (ffi_bridge.cc): every tensor enqueues before
+    # any wait inside one blocking call, so a step's gradient reduction
+    # cannot cross-rank deadlock regardless of XLA's schedule.  Several
+    # INDEPENDENT grouped calls in one program must be ordered by data
+    # flow (true for optimizer steps; HVD_NO_FFI_BRIDGE=1 opts out and
+    # the stall inspector names the tensors if a custom program trips
+    # this).
+    if _ffi_eligible(leaves, compression):
+        return tuple(_ffi_grouped_call(
+            list(leaves), base, op, 1.0, 1.0, process_set))
     return _io_callback(
         partial(_host_grouped_allreduce, base, op, compression,
                 process_set),

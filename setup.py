@@ -19,16 +19,23 @@ ROOT = Path(__file__).resolve().parent
 CSRC = ROOT / "csrc"
 OUT = ROOT / "horovod_tpu" / "_lib" / "libhvd_core.so"
 
-SOURCES = ["wire.cc", "sockets.cc", "kernels.cc", "autotune.cc",
-           "timeline.cc", "engine.cc", "c_api.cc"]
-
 
 def build_native():
+    # One build recipe: the Makefile.  The FFI-header probe result from
+    # THIS interpreter rides in via JAX_INC so wheel and hand builds
+    # cannot drift (XLA custom-call handlers compile in when jaxlib
+    # ships its headers; pure-ctypes core otherwise).
     OUT.parent.mkdir(parents=True, exist_ok=True)
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
-           "-pthread", "-shared", *SOURCES, "-o", str(OUT)]
+    jax_inc = ""
+    try:
+        import jax.ffi as _jax_ffi
+
+        jax_inc = _jax_ffi.include_dir()
+    except Exception:
+        pass
+    cmd = ["make", "-C", str(CSRC), f"JAX_INC={jax_inc}"]
     print(" ".join(cmd), file=sys.stderr)
-    subprocess.run(cmd, cwd=CSRC, check=True)
+    subprocess.run(cmd, check=True)
 
 
 class BuildPyWithNative(build_py):

@@ -574,6 +574,21 @@ def scenario_bridge_jit():
     assert out_jit.tobytes() == out_eager.tobytes(), \
         "bridge allreduce != eager allreduce bitwise"
 
+    # On the native engine the compiled program must carry the XLA
+    # custom call straight into the C++ engine (ffi_bridge.cc) — no
+    # Python on the hot path; the py engine lowers to the host callback.
+    from horovod_tpu import basics as _basics
+    from horovod_tpu.ops import bridge as _bridge
+
+    if type(_basics._runtime).__name__ == "NativeEngine":
+        assert _bridge._native_ffi_ready(), "native FFI path not engaged"
+        # grouped = the FFI custom call; single = ordered host callback
+        # (execution-order guarantee) — check both lowerings.
+        txt = jax.jit(lambda t: hvd.grouped_allreduce(
+            [t, t * 2], op=hvd.Sum, name="br.ffi.check")).lower(
+                jnp.asarray(x)).as_text()
+        assert "hvd_grouped_allreduce" in txt, txt[:800]
+
     # a jitted training step whose gradient reduction rides the engine
     # through grouped_allreduce (controller fusion on the compiled path)
     w = jnp.asarray(np.linspace(0.5, 1.5, 16, dtype=np.float32))
