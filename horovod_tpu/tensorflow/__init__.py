@@ -80,6 +80,24 @@ def _engine_call(fn, x, out_dtype):
     return y
 
 
+def _native_kernels(x, process_set):
+    """(op_library, ps_id, ps_size) when the C++ custom kernels
+    (csrc/tf_ops.cc — real graph ops into the native engine, the
+    reference's mpi_ops.cc mechanism) can serve this tensor, else
+    (None, 0, 0) and the py_function path runs."""
+    from horovod_tpu.tensorflow import _native_ops
+
+    if x.dtype.name not in _native_ops.SUPPORTED_DTYPES:
+        return None, 0, 0
+    nlib = _native_ops.lib()
+    if nlib is None:
+        return None, 0, 0
+    ps_id, ps_size = 0, 0
+    if process_set is not None:
+        ps_id, ps_size = process_set.validate(rank(), size())
+    return nlib, ps_id, ps_size
+
+
 def allreduce(tensor, average=None, device_dense="", device_sparse="",
               compression=Compression.none, op=None, name=None,
               process_set=None):
@@ -105,13 +123,19 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
 
     @tf.custom_gradient
     def _fn(x):
-        y = _engine_call(
-            lambda v: _eager.allreduce(v, op=rop, name=nm,
-                                       process_set=process_set),
-            x, x.dtype)
-        # The engine flattens 0-d scalars to shape (1,); restore.
-        y = tf.reshape(y, tf.shape(x))
-        y.set_shape(x.shape)
+        nlib, ps_id, ps_size = _native_kernels(x, process_set)
+        if nlib is not None:
+            y = nlib.hvd_allreduce(
+                x, tensor_name=nm, reduce_op=int(rop),
+                process_set_id=ps_id, process_set_size=ps_size)
+        else:
+            y = _engine_call(
+                lambda v: _eager.allreduce(v, op=rop, name=nm,
+                                           process_set=process_set),
+                x, x.dtype)
+            # The engine flattens 0-d scalars to shape (1,); restore.
+            y = tf.reshape(y, tf.shape(x))
+            y.set_shape(x.shape)
 
         def grad(dy):
             # Derived (trace-time) names keep every rank's runtime naming
@@ -133,10 +157,16 @@ def allgather(tensor, name=None, process_set=None):
 
     @tf.custom_gradient
     def _fn(x):
-        y = _engine_call(
-            lambda v: _eager.allgather(v, name=nm,
-                                       process_set=process_set),
-            x, x.dtype)
+        nlib, ps_id, ps_size = _native_kernels(x, process_set)
+        if nlib is not None:
+            y = nlib.hvd_allgather(
+                x, tensor_name=nm, process_set_id=ps_id,
+                process_set_size=ps_size)
+        else:
+            y = _engine_call(
+                lambda v: _eager.allgather(v, name=nm,
+                                           process_set=process_set),
+                x, x.dtype)
         y.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
 
         def grad(dy):
@@ -203,13 +233,20 @@ def broadcast(tensor, root_rank=0, name=None, process_set=None):
 
     @tf.custom_gradient
     def _fn(x):
-        y = _engine_call(
-            lambda v: _eager.broadcast(v, root_rank=root_rank, name=nm,
-                                       process_set=process_set),
-            x, x.dtype)
-        # The engine flattens 0-d scalars to shape (1,); restore.
-        y = tf.reshape(y, tf.shape(x))
-        y.set_shape(x.shape)
+        nlib, ps_id, ps_size = _native_kernels(x, process_set)
+        if nlib is not None:
+            y = nlib.hvd_broadcast(
+                x, tensor_name=nm, root_rank=root_rank,
+                process_set_id=ps_id, process_set_size=ps_size)
+        else:
+            y = _engine_call(
+                lambda v: _eager.broadcast(v, root_rank=root_rank,
+                                           name=nm,
+                                           process_set=process_set),
+                x, x.dtype)
+            # The engine flattens 0-d scalars to shape (1,); restore.
+            y = tf.reshape(y, tf.shape(x))
+            y.set_shape(x.shape)
 
         def grad(dy):
             reduced = allreduce(dy, op=ReduceOp.SUM, name=f"{nm}.grad",

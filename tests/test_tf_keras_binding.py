@@ -80,6 +80,12 @@ def test_keras_fit():
     run_tf_workers("keras_fit", 2)
 
 
+def test_tf_native_ops():
+    """The C++ custom kernels (csrc/tf_ops.cc) serve the TF surface on
+    the native engine: real graph ops, correct math, differentiable."""
+    run_tf_workers("native_ops", 2)
+
+
 def test_tf_backward_passes_per_step():
     # Local gradient aggregation over N passes, exact math at 2 ranks
     # (ref tensorflow/__init__.py:443).
