@@ -291,6 +291,11 @@ void hvd_cache_stats(int64_t* out) {
   g_engine->CacheStats(out);
 }
 
+// CPU capability probe for diagnostics (hvdrun --check-build).
+int hvd_simd_available() {
+  return hvd::SimdRuntimeAvailable() ? 1 : 0;
+}
+
 // Microbenchmark hook for the wire-codec combine loops (the per-hop hot
 // path of compressed ring traffic; parity target: half.cc:43-77's
 // vectorized fp16 sum).  Runs `iters` combines of an n-element buffer

@@ -422,6 +422,8 @@ void CombineFp8Pairwise(uint8_t* d, const uint8_t* s, size_t n,
 
 }  // namespace
 
+bool SimdRuntimeAvailable() { return SimdAvailable(); }
+
 void CombineInto(void* dst, const void* incoming, size_t n, DataType dt,
                  ReduceOp op) {
   switch (dt) {

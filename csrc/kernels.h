@@ -16,6 +16,11 @@
 
 namespace hvd {
 
+// True when the CPU carries the AVX2+F16C fast paths (runtime probe;
+// the authoritative gate behind the vectorized combines and
+// `hvd_simd_available` in the C API).
+bool SimdRuntimeAvailable();
+
 // fp16 (IEEE binary16) <-> fp32.
 float HalfToFloat(uint16_t h);
 uint16_t FloatToHalf(float f);

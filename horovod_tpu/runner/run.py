@@ -38,12 +38,112 @@ def _prog_name() -> str:
     return base if base in ("hvdrun", "horovodrun") else "hvdrun"
 
 
+def check_build() -> str:
+    """Availability report (parity: ``horovodrun --check-build``,
+    run/run.py:116-151 — frameworks / controllers / tensor ops, reshaped
+    for this stack's components).  A component counts as available when
+    it is built OR buildable on demand (sources + toolchain — the same
+    criterion ``native.load`` / ``_native_ops.lib`` apply).  Paths come
+    from the loaders themselves, not re-derived here."""
+    import importlib.util
+
+    from horovod_tpu import native as native_mod
+
+    def have(mod):
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except Exception:
+            return False
+
+    def mark(v):
+        return "X" if v else " "
+
+    core = str(native_mod._LIB_PATH)
+    csrc = str(native_mod._CSRC_DIR)
+    buildable = os.path.isdir(csrc) and _toolchain()
+    core_built = os.path.exists(core)
+    native_core = core_built or buildable
+    # FFI symbol: present in the built core, or will be compiled in on
+    # the next build (sources + toolchain + jaxlib's FFI headers).
+    ffi = False
+    if core_built:
+        try:
+            import ctypes
+
+            ffi = hasattr(ctypes.CDLL(core), "HvdGroupedAllreduce")
+        except Exception:
+            ffi = False
+    if not ffi and buildable and have("jax"):
+        ffi = os.path.isfile(os.path.join(csrc, "ffi_bridge.cc"))
+    # SIMD: ask the built core's runtime cpuid probe (authoritative);
+    # fall back to cpuinfo flags when nothing is built yet.
+    simd = False
+    if core_built:
+        try:
+            import ctypes
+
+            lib = ctypes.CDLL(core)
+            simd = bool(getattr(lib, "hvd_simd_available")())
+        except Exception:
+            simd = False
+    else:
+        try:
+            with open("/proc/cpuinfo") as f:
+                flags = f.read()
+            simd = "avx2" in flags and "f16c" in flags
+        except OSError:
+            simd = False
+    # Library dir from the core loader (single source); the tf-ops
+    # filename matches tensorflow/_native_ops._SO — not imported here
+    # because that package import pulls TensorFlow itself (~seconds),
+    # and --check-build must stay fast.
+    tf_so = os.path.join(os.path.dirname(core), "libhvd_tf_ops.so")
+    tf_kernels = have("tensorflow") and (
+        os.path.exists(tf_so)
+        or (os.path.isfile(os.path.join(csrc, "tf_ops.cc"))
+            and _toolchain()))
+    return f"""horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [{mark(have('jax'))}] JAX (in-graph collectives + engine bridge)
+    [{mark(have('tensorflow'))}] TensorFlow
+    [{mark(have('torch'))}] PyTorch
+    [{mark(have('keras'))}] Keras
+    [{mark(have('mxnet'))}] MXNet
+
+Available Engines:
+    [{mark(native_core)}] native C++ core (libhvd_core.so)
+    [X] Python engine (wire-compatible twin, always available)
+
+Available Native Components:
+    [{mark(ffi)}] XLA FFI custom call (jit grouped allreduce)
+    [{mark(tf_kernels)}] TensorFlow custom kernels (HvdAllreduce/...)
+    [{mark(simd)}] SIMD wire codecs (AVX2 + F16C)
+    [X] XLA/ICI in-graph collectives (psum/all_gather/ppermute)"""
+
+
+def _toolchain() -> bool:
+    import shutil
+
+    return shutil.which(os.environ.get("CXX", "g++")) is not None
+
+
+class _CheckBuildAction(argparse.Action):
+    def __call__(self, parser, namespace, values, option_string=None):
+        print(check_build())
+        sys.exit(0)
+
+
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog=_prog_name(),
         description="Launch a horovod_tpu distributed job.")
     p.add_argument("-v", "--version", action="version",
                    version=__version__)
+    p.add_argument("-cb", "--check-build", nargs=0,
+                   action=_CheckBuildAction,
+                   help="print available frameworks/engines/native "
+                        "components and exit")
     p.add_argument("-np", "--num-proc", type=int, required=True,
                    dest="np", help="total number of processes")
     g = p.add_mutually_exclusive_group()

@@ -471,6 +471,21 @@ def test_mpirun_command_mpich():
                            impl=mpi.MpiImpl.MPICH, ssh_port=2222)
 
 
+def test_check_build(capsys):
+    # Parity: horovodrun --check-build (run/run.py:116-151) — prints the
+    # availability report and exits 0, before -np validation.
+    from horovod_tpu.runner import run as run_mod
+
+    with pytest.raises(SystemExit) as e:
+        run_mod.run_commandline(["--check-build"])
+    assert e.value.code == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "Python engine" in out
+    assert "Available Native Components" in out
+
+
 def test_cli_mpirun_without_mpi_errors(capsys):
     # No mpirun on PATH → actionable exit-2, not a traceback (the e2e
     # run is covered on hosts that have MPI; documented skip here).
