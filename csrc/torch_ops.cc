@@ -1,0 +1,207 @@
+// PyTorch custom ops backed by the native engine.
+//
+// Role parity: horovod/torch/mpi_ops_v2.cc — ops registered with the
+// dispatcher whose kernels enqueue into the shared coordinator.  Loaded
+// with torch.ops.load_library; horovod_tpu.torch routes its collectives
+// through torch.ops.hvd.* when available (native engine + toolchain),
+// keeping the numpy/ctypes path as the fallback.  Because these are
+// dispatcher ops, torch.compile-traced graphs carry them as calls
+// instead of graph breaks.
+
+#include <cstring>
+#include <string>
+
+#include <torch/library.h>
+#include <ATen/ATen.h>
+
+#include "engine.h"
+
+extern "C" void* hvd_engine_handle();
+
+namespace {
+
+bool MapDtype(at::ScalarType t, hvd::DataType* out) {
+  switch (t) {
+    case at::kFloat:
+      *out = hvd::DataType::FLOAT32;
+      return true;
+    case at::kDouble:
+      *out = hvd::DataType::FLOAT64;
+      return true;
+    case at::kHalf:
+      *out = hvd::DataType::FLOAT16;
+      return true;
+    case at::kBFloat16:
+      *out = hvd::DataType::BFLOAT16;
+      return true;
+    case at::kInt:
+      *out = hvd::DataType::INT32;
+      return true;
+    case at::kLong:
+      *out = hvd::DataType::INT64;
+      return true;
+    case at::kByte:
+      *out = hvd::DataType::UINT8;
+      return true;
+    case at::kChar:
+      *out = hvd::DataType::INT8;
+      return true;
+    case at::kBool:
+      *out = hvd::DataType::BOOL;
+      return true;
+    default:
+      return false;
+  }
+}
+
+hvd::Engine* EngineOrThrow() {
+  auto* eng = static_cast<hvd::Engine*>(hvd_engine_handle());
+  TORCH_CHECK(eng != nullptr,
+              "horovod_tpu native engine is not initialized");
+  return eng;
+}
+
+hvd::TensorShape ShapeOf(const at::Tensor& t) {
+  hvd::TensorShape s;
+  for (auto d : t.sizes()) s.dims.push_back(d);
+  if (s.dims.empty()) s.dims.push_back(1);  // 0-d lift, ctypes parity
+  return s;
+}
+
+void WaitOrThrow(hvd::Engine* eng, int64_t h) {
+  hvd::StatusType st = eng->handles().Wait(h);
+  std::string reason;
+  if (st != hvd::StatusType::OK) {
+    auto* state = eng->handles().Get(h);
+    reason = state != nullptr && !state->status.reason.empty()
+                 ? state->status.reason
+                 : "collective failed";
+  }
+  eng->handles().Release(h);
+  TORCH_CHECK(reason.empty(), reason);
+}
+
+at::Tensor Allreduce(const at::Tensor& input, std::string tensor_name,
+                     int64_t reduce_op, double prescale, double postscale,
+                     int64_t ps_id, int64_t ps_size) {
+  auto* eng = EngineOrThrow();
+  at::Tensor out = input.is_contiguous() ? input.clone()
+                                         : input.contiguous();
+  hvd::DataType dt;
+  TORCH_CHECK(MapDtype(out.scalar_type(), &dt),
+              "unsupported dtype for engine allreduce");
+  std::string err;
+  int64_t h = eng->EnqueueAllreduce(
+      tensor_name, out.data_ptr(), ShapeOf(out), dt,
+      static_cast<hvd::ReduceOp>(reduce_op), prescale, postscale, &err,
+      static_cast<int32_t>(ps_id), static_cast<int32_t>(ps_size));
+  TORCH_CHECK(h >= 0, err);
+  WaitOrThrow(eng, h);
+  return out;
+}
+
+// In-place variant: reduces directly into the caller's tensor (parity:
+// hvd.allreduce_ — mpi_ops_v2.cc's DoAllreduce writes the output in
+// place).
+at::Tensor& AllreduceInplace(at::Tensor& input, std::string tensor_name,
+                             int64_t reduce_op, double prescale,
+                             double postscale, int64_t ps_id,
+                             int64_t ps_size) {
+  auto* eng = EngineOrThrow();
+  TORCH_CHECK(input.is_contiguous(),
+              "in-place allreduce needs a contiguous tensor");
+  hvd::DataType dt;
+  TORCH_CHECK(MapDtype(input.scalar_type(), &dt),
+              "unsupported dtype for engine allreduce");
+  std::string err;
+  int64_t h = eng->EnqueueAllreduce(
+      tensor_name, input.data_ptr(), ShapeOf(input), dt,
+      static_cast<hvd::ReduceOp>(reduce_op), prescale, postscale, &err,
+      static_cast<int32_t>(ps_id), static_cast<int32_t>(ps_size));
+  TORCH_CHECK(h >= 0, err);
+  WaitOrThrow(eng, h);
+  return input;
+}
+
+at::Tensor Broadcast(const at::Tensor& input, std::string tensor_name,
+                     int64_t root_rank, int64_t ps_id, int64_t ps_size) {
+  auto* eng = EngineOrThrow();
+  at::Tensor out = input.is_contiguous() ? input.clone()
+                                         : input.contiguous();
+  hvd::DataType dt;
+  TORCH_CHECK(MapDtype(out.scalar_type(), &dt),
+              "unsupported dtype for engine broadcast");
+  std::string err;
+  int64_t h = eng->EnqueueBroadcast(
+      tensor_name, out.data_ptr(), ShapeOf(out), dt,
+      static_cast<int32_t>(root_rank), &err, static_cast<int32_t>(ps_id),
+      static_cast<int32_t>(ps_size));
+  TORCH_CHECK(h >= 0, err);
+  WaitOrThrow(eng, h);
+  return out;
+}
+
+at::Tensor Allgather(const at::Tensor& input, std::string tensor_name,
+                     int64_t ps_id, int64_t ps_size) {
+  auto* eng = EngineOrThrow();
+  at::Tensor in = input.contiguous();
+  hvd::DataType dt;
+  TORCH_CHECK(MapDtype(in.scalar_type(), &dt),
+              "unsupported dtype for engine allgather");
+  std::string err;
+  int64_t h = eng->EnqueueAllgather(
+      tensor_name, in.data_ptr(), ShapeOf(in), dt, &err,
+      static_cast<int32_t>(ps_id), static_cast<int32_t>(ps_size));
+  TORCH_CHECK(h >= 0, err);
+  hvd::StatusType st = eng->handles().Wait(h);
+  auto* state = eng->handles().Get(h);
+  if (st != hvd::StatusType::OK || state == nullptr) {
+    std::string reason = state != nullptr && !state->status.reason.empty()
+                             ? state->status.reason
+                             : "allgather failed";
+    eng->handles().Release(h);
+    TORCH_CHECK(false, reason);
+  }
+  // Negotiated first-dim size: rows derive from dims[1:] (zero-row
+  // contributions included).
+  int64_t row = 1;
+  for (size_t i = 1; i < in.sizes().size(); ++i) row *= in.size(i);
+  int64_t elem = in.element_size();
+  int64_t total_rows =
+      elem > 0 && row > 0
+          ? static_cast<int64_t>(state->result.size()) / (elem * row)
+          : 0;
+  std::vector<int64_t> shape(in.sizes().begin(), in.sizes().end());
+  if (shape.empty()) shape.push_back(1);
+  shape[0] = total_rows;
+  at::Tensor out = at::empty(shape, in.options());
+  std::memcpy(out.data_ptr(), state->result.data(), state->result.size());
+  eng->handles().Release(h);
+  return out;
+}
+
+}  // namespace
+
+TORCH_LIBRARY(hvd, m) {
+  m.def(
+      "allreduce(Tensor input, str tensor_name, int reduce_op, "
+      "float prescale, float postscale, int ps_id, int ps_size) "
+      "-> Tensor");
+  m.def(
+      "allreduce_(Tensor(a!) input, str tensor_name, int reduce_op, "
+      "float prescale, float postscale, int ps_id, int ps_size) "
+      "-> Tensor(a!)");
+  m.def(
+      "broadcast(Tensor input, str tensor_name, int root_rank, "
+      "int ps_id, int ps_size) -> Tensor");
+  m.def(
+      "allgather(Tensor input, str tensor_name, int ps_id, "
+      "int ps_size) -> Tensor");
+}
+
+TORCH_LIBRARY_IMPL(hvd, CPU, m) {
+  m.impl("allreduce", Allreduce);
+  m.impl("allreduce_", AllreduceInplace);
+  m.impl("broadcast", Broadcast);
+  m.impl("allgather", Allgather);
+}

@@ -1,0 +1,78 @@
+"""Shared build-on-demand machinery for framework custom-op libraries.
+
+The TF kernels (``csrc/tf_ops.cc``) and torch dispatcher ops
+(``csrc/torch_ops.cc``) follow one pattern: compile against the
+installed framework's headers, link ``libhvd_core.so`` with an
+``$ORIGIN`` rpath, publish atomically (gangs race to build), and track
+staleness against every header the kernels transitively include.  One
+implementation here; the per-framework loaders supply only flags and
+the ``load`` call.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Sequence
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_DIR = os.path.join(_PKG_DIR, "_lib")
+CORE_SO = os.path.join(LIB_DIR, "libhvd_core.so")
+CSRC_DIR = os.path.normpath(os.path.join(_PKG_DIR, os.pardir, "csrc"))
+
+# Everything the framework-op translation units may include; a change
+# in ANY of these (enum values in types.h especially) must force a
+# rebuild or a stale library would map wire enums wrongly.
+_DEP_HEADERS = ("engine.h", "types.h", "kernels.h", "wire.h",
+                "sockets.h", "timeline.h", "autotune.h")
+
+
+def cxx() -> str:
+    return os.environ.get("CXX", "g++")
+
+
+def needs_build(src: str, so: str) -> bool:
+    if not os.path.isfile(src):
+        return False  # wheel install: use the prebuilt .so or fall back
+    if not os.path.exists(so):
+        return True
+    deps = [src, CORE_SO]
+    deps += [os.path.join(CSRC_DIR, h) for h in _DEP_HEADERS]
+    newest = max(os.path.getmtime(p) for p in deps if os.path.exists(p))
+    return os.path.getmtime(so) < newest
+
+
+def build(src: str, so: str, extra_flags: Sequence[str],
+          extra_links: Sequence[str]) -> None:
+    """Compile ``src`` into ``so`` linking the engine core.  Gang-safe:
+    compile to a per-pid temp, publish with an atomic rename."""
+    tmp = f"{so}.tmp.{os.getpid()}"
+    cmd = [cxx(), "-O2", "-std=c++17", "-fPIC", "-w",
+           f"-I{CSRC_DIR}", *extra_flags,
+           "-shared", src,
+           f"-L{os.path.dirname(CORE_SO)}", "-l:libhvd_core.so",
+           "-Wl,-rpath,$ORIGIN", *extra_links,
+           "-o", tmp]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=600)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"build of {os.path.basename(so)} failed: "
+                f"{r.stderr[-800:]}")
+        os.replace(tmp, so)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def native_engine_active() -> bool:
+    """Common precondition: the C++ engine the kernels enqueue into is
+    live in this process (re-checked per call — never latched)."""
+    try:
+        from horovod_tpu import basics
+        from horovod_tpu.runtime_native import NativeEngine
+
+        return isinstance(basics._engine(), NativeEngine)
+    except Exception:
+        return False

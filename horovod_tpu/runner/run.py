@@ -63,36 +63,31 @@ def check_build() -> str:
     buildable = os.path.isdir(csrc) and _toolchain()
     core_built = os.path.exists(core)
     native_core = core_built or buildable
-    # FFI symbol: present in the built core, or will be compiled in on
-    # the next build (sources + toolchain + jaxlib's FFI headers).
-    ffi = False
-    if core_built:
-        try:
-            import ctypes
-
-            ffi = hasattr(ctypes.CDLL(core), "HvdGroupedAllreduce")
-        except Exception:
-            ffi = False
-    if not ffi and buildable and have("jax"):
-        ffi = os.path.isfile(os.path.join(csrc, "ffi_bridge.cc"))
-    # SIMD: ask the built core's runtime cpuid probe (authoritative);
-    # fall back to cpuinfo flags when nothing is built yet.
-    simd = False
+    # One dlopen serves both probes: the FFI symbol, and the runtime
+    # cpuid gate (authoritative for SIMD); cpuinfo flags are the
+    # pre-build fallback.
+    ffi, simd = False, False
     if core_built:
         try:
             import ctypes
 
             lib = ctypes.CDLL(core)
-            simd = bool(getattr(lib, "hvd_simd_available")())
+            ffi = hasattr(lib, "HvdGroupedAllreduce")
+            simd = bool(lib.hvd_simd_available())
         except Exception:
-            simd = False
+            pass
     else:
         try:
             with open("/proc/cpuinfo") as f:
                 flags = f.read()
             simd = "avx2" in flags and "f16c" in flags
         except OSError:
-            simd = False
+            pass
+    if not ffi and buildable and have("jax"):
+        ffi = os.path.isfile(os.path.join(csrc, "ffi_bridge.cc"))
+    simd_note = ""
+    if os.environ.get("HVD_NO_SIMD") == "1":
+        simd, simd_note = False, " (disabled by HVD_NO_SIMD=1)"
     # Library dir from the core loader (single source); the tf-ops
     # filename matches tensorflow/_native_ops._SO — not imported here
     # because that package import pulls TensorFlow itself (~seconds),
@@ -101,6 +96,12 @@ def check_build() -> str:
     tf_kernels = have("tensorflow") and (
         os.path.exists(tf_so)
         or (os.path.isfile(os.path.join(csrc, "tf_ops.cc"))
+            and _toolchain()))
+    torch_so = os.path.join(os.path.dirname(core),
+                            "libhvd_torch_ops.so")
+    torch_kernels = have("torch") and (
+        os.path.exists(torch_so)
+        or (os.path.isfile(os.path.join(csrc, "torch_ops.cc"))
             and _toolchain()))
     return f"""horovod_tpu v{__version__}:
 
@@ -118,7 +119,8 @@ Available Engines:
 Available Native Components:
     [{mark(ffi)}] XLA FFI custom call (jit grouped allreduce)
     [{mark(tf_kernels)}] TensorFlow custom kernels (HvdAllreduce/...)
-    [{mark(simd)}] SIMD wire codecs (AVX2 + F16C)
+    [{mark(torch_kernels)}] PyTorch dispatcher ops (torch.ops.hvd.*)
+    [{mark(simd)}] SIMD wire codecs (AVX2 + F16C){simd_note}
     [X] XLA/ICI in-graph collectives (psum/all_gather/ppermute)"""
 
 

@@ -3,22 +3,25 @@
 ``csrc/tf_ops.cc`` registers ``HvdAllreduce`` / ``HvdBroadcast`` /
 ``HvdAllgather`` — real graph ops whose kernels enqueue straight into
 the native C++ engine (the reference's ``tensorflow/mpi_ops.cc``
-mechanism).  This module compiles that file against the installed
-TensorFlow's headers the first time it is needed (dev checkouts with a
-toolchain), caches ``horovod_tpu/_lib/libhvd_tf_ops.so``, and loads it
-with ``tf.load_op_library``.
+mechanism).  Built on demand against the installed TensorFlow's headers
+via the shared machinery in ``horovod_tpu.common.native_build``;
+``HVD_TF_NATIVE_OPS=0`` opts out.
 
-Falls back to ``None`` — and the front-end to its ``tf.py_function``
-path — when any precondition is missing: the Python engine is active
-(the kernels reach only the in-process C++ engine), no compiler, no
-checkout sources and no prebuilt library, or ``HVD_TF_NATIVE_OPS=0``.
+Preconditions (engine type, env switch) re-evaluate on EVERY call — a
+collective issued before ``hvd.init()``, or an init→shutdown→re-init
+cycle onto a different engine, must not latch the fast path off for the
+process lifetime.  Only a genuine build/load failure latches (retrying
+a broken compile every op call would be worse).  Falls back to ``None``
+— and the front-end to its ``tf.py_function`` path — whenever any
+precondition is missing.
 """
 
 from __future__ import annotations
 
 import os
-import subprocess
 import threading
+
+from horovod_tpu.common import native_build
 
 _lock = threading.Lock()
 _lib = None
@@ -30,23 +33,15 @@ SUPPORTED_DTYPES = frozenset({
     "float32", "float64", "float16", "bfloat16", "int32", "int64",
     "uint8", "int8", "bool"})
 
-_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_SO = os.path.join(_PKG_DIR, "_lib", "libhvd_tf_ops.so")
-_CORE = os.path.join(_PKG_DIR, "_lib", "libhvd_core.so")
-_CSRC = os.path.normpath(os.path.join(_PKG_DIR, os.pardir, "csrc"))
+_SO = os.path.join(native_build.LIB_DIR, "libhvd_tf_ops.so")
 
 
 def lib():
-    """The loaded op library, or None when the native path is off.
-
-    Preconditions (engine type, env switch) re-evaluate on EVERY call —
-    a collective issued before ``hvd.init()``, or an init→shutdown→
-    re-init cycle onto a different engine, must not latch the fast path
-    off for the process lifetime.  Only a genuine build/load failure
-    latches (retrying a broken compile every op call would be worse).
-    """
+    """The loaded op library, or None when the native path is off."""
     global _lib, _failed
-    if not _preconditions_ok():
+    if os.environ.get("HVD_TF_NATIVE_OPS", "1") == "0":
+        return None
+    if not native_build.native_engine_active():
         return None
     if _lib is not None or _failed:
         return _lib
@@ -63,60 +58,15 @@ def lib():
     return _lib
 
 
-def _preconditions_ok() -> bool:
-    if os.environ.get("HVD_TF_NATIVE_OPS", "1") == "0":
-        return False
-    try:
-        from horovod_tpu import basics
-        from horovod_tpu.runtime_native import NativeEngine
-
-        # Single-process / py engines never create the C++ engine the
-        # kernels enqueue into.
-        return isinstance(basics._engine(), NativeEngine)
-    except Exception:
-        return False
-
-
 def _build_and_load():
     import tensorflow as tf
 
-    src = os.path.join(_CSRC, "tf_ops.cc")
-    if _needs_build(src):
-        _build(tf, src)
+    src = os.path.join(native_build.CSRC_DIR, "tf_ops.cc")
+    if native_build.needs_build(src, _SO):
+        native_build.build(
+            src, _SO,
+            extra_flags=tf.sysconfig.get_compile_flags(),
+            extra_links=tf.sysconfig.get_link_flags())
     if not os.path.exists(_SO):
         raise RuntimeError(f"{_SO} not built and no sources to build it")
     return tf.load_op_library(_SO)
-
-
-def _needs_build(src: str) -> bool:
-    if not os.path.isfile(src):
-        return False  # wheel install: use the prebuilt .so or fall back
-    if not os.path.exists(_SO):
-        return True
-    newest = max(os.path.getmtime(p) for p in (
-        src, os.path.join(_CSRC, "engine.h"), _CORE))
-    return os.path.getmtime(_SO) < newest
-
-
-def _build(tf, src: str) -> None:
-    # Gang-safe: every local rank may race to build; compile to a
-    # per-pid temp and atomically publish, so loaders only ever see a
-    # complete library.
-    tmp = f"{_SO}.tmp.{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-w",
-           f"-I{_CSRC}",
-           *tf.sysconfig.get_compile_flags(),
-           "-shared", src,
-           f"-L{os.path.dirname(_CORE)}", "-l:libhvd_core.so",
-           "-Wl,-rpath,$ORIGIN",
-           *tf.sysconfig.get_link_flags(),
-           "-o", tmp]
-    try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=600)
-        if r.returncode != 0:
-            raise RuntimeError(f"tf_ops build failed: {r.stderr[-800:]}")
-        os.replace(tmp, _SO)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)

@@ -1,0 +1,74 @@
+"""On-demand build + load of the PyTorch custom-op library.
+
+``csrc/torch_ops.cc`` registers ``torch.ops.hvd.allreduce`` /
+``allreduce_`` / ``broadcast`` / ``allgather`` — dispatcher ops whose
+kernels enqueue straight into the native C++ engine (the reference's
+``torch/mpi_ops_v2.cc`` mechanism).  Built on demand against the
+installed torch's headers via the shared machinery in
+``horovod_tpu.common.native_build``; ``torch.compile`` traces carry the
+ops as dispatcher calls.  Preconditions (native engine, env switch)
+re-check per call; only genuine build/load failures latch.
+``HVD_TORCH_NATIVE_OPS=0`` opts out; the numpy/ctypes path is always
+the fallback.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from horovod_tpu.common import native_build
+
+_lock = threading.Lock()
+_loaded = False
+_failed = False
+
+SUPPORTED_DTYPES = frozenset({
+    "torch.float32", "torch.float64", "torch.float16", "torch.bfloat16",
+    "torch.int32", "torch.int64", "torch.uint8", "torch.int8",
+    "torch.bool"})
+
+_SO = os.path.join(native_build.LIB_DIR, "libhvd_torch_ops.so")
+
+
+def available() -> bool:
+    """True when ``torch.ops.hvd.*`` can serve this process's engine."""
+    global _loaded, _failed
+    if os.environ.get("HVD_TORCH_NATIVE_OPS", "1") == "0":
+        return False
+    if not native_build.native_engine_active():
+        return False
+    if _loaded or _failed:
+        return _loaded
+    with _lock:
+        if _loaded or _failed:
+            return _loaded
+        try:
+            _build_and_load()
+            _loaded = True
+        except Exception as e:
+            _failed = True
+            from horovod_tpu.utils.logging import get_logger
+
+            get_logger().debug(f"torch native ops unavailable: {e}")
+    return _loaded
+
+
+def _build_and_load():
+    import torch
+
+    src = os.path.join(native_build.CSRC_DIR, "torch_ops.cc")
+    if native_build.needs_build(src, _SO):
+        import torch.utils.cpp_extension as ce
+
+        abi = int(getattr(torch._C, "_GLIBCXX_USE_CXX11_ABI", True))
+        torch_lib = os.path.join(os.path.dirname(torch.__file__), "lib")
+        native_build.build(
+            src, _SO,
+            extra_flags=[*(f"-I{p}" for p in ce.include_paths()),
+                         f"-D_GLIBCXX_USE_CXX11_ABI={abi}"],
+            extra_links=[f"-L{torch_lib}", "-ltorch", "-ltorch_cpu",
+                         "-lc10", f"-Wl,-rpath,{torch_lib}"])
+    if not os.path.exists(_SO):
+        raise RuntimeError(f"{_SO} not built and no sources to build it")
+    torch.ops.load_library(_SO)

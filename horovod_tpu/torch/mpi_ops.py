@@ -37,6 +37,25 @@ Max = ReduceOp.MAX
 Product = ReduceOp.PRODUCT
 
 
+def _native_dispatch(tensor: torch.Tensor, process_set):
+    """(True, ps_id, ps_size) when the C++ dispatcher ops
+    (csrc/torch_ops.cc — torch.ops.hvd.*, the reference's mpi_ops_v2.cc
+    mechanism) can serve this tensor; CPU tensors only (device tensors
+    keep the host-staging numpy path)."""
+    from horovod_tpu.torch import _native_ops
+
+    if tensor.device.type != "cpu" or \
+            str(tensor.dtype) not in _native_ops.SUPPORTED_DTYPES:
+        return False, 0, 0
+    if not _native_ops.available():
+        return False, 0, 0
+    ps_id, ps_size = 0, 0
+    if process_set is not None:
+        ps_id, ps_size = process_set.validate(basics.rank(),
+                                              basics.size())
+    return True, ps_id, ps_size
+
+
 def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
     t = tensor.detach()
     if t.device.type != "cpu":
@@ -117,6 +136,15 @@ class _HorovodAllreduce(torch.autograd.Function):
         ctx.prescale = prescale
         ctx.postscale = postscale
         ctx.process_set = process_set
+        native, ps_id, ps_size = _native_dispatch(tensor, process_set)
+        if native:
+            # backward re-enters with name=None; draw from the same
+            # noname counter the numpy path would
+            nm = name if name is not None \
+                else _auto_name("torch.allreduce", None)
+            return torch.ops.hvd.allreduce(
+                tensor, nm, int(_resolve_op(op, average)),
+                float(prescale), float(postscale), ps_id, ps_size)
         return synchronize(allreduce_async(tensor, average, name, op,
                                            prescale, postscale,
                                            process_set))
@@ -146,6 +174,16 @@ def allreduce(tensor, average=None, name=None, compression=None, op=None,
 def allreduce_(tensor, average=None, name=None, op=None,
                prescale_factor=1.0, postscale_factor=1.0,
                process_set=None) -> torch.Tensor:
+    native, ps_id, ps_size = _native_dispatch(tensor, process_set)
+    if native and tensor.is_contiguous():
+        # The in-place dispatcher op reduces directly into the caller's
+        # storage (mpi_ops_v2.cc parity).
+        nm = name if name is not None \
+            else _auto_name("torch.allreduce", None)
+        return torch.ops.hvd.allreduce_(
+            tensor, nm, int(_resolve_op(op, average)),
+            float(prescale_factor), float(postscale_factor), ps_id,
+            ps_size)
     return synchronize(allreduce_async_(tensor, average, name, op,
                                         prescale_factor, postscale_factor,
                                         process_set))
@@ -197,6 +235,11 @@ class _HorovodAllgather(torch.autograd.Function):
     def forward(ctx, tensor, name, process_set=None):
         ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
         ctx.process_set = process_set
+        native, ps_id, ps_size = _native_dispatch(tensor, process_set)
+        if native:
+            nm = name if name is not None \
+                else _auto_name("torch.allgather", None)
+            return torch.ops.hvd.allgather(tensor, nm, ps_id, ps_size)
         return synchronize(allgather_async(tensor, name, process_set))
 
     @staticmethod
@@ -297,6 +340,12 @@ class _HorovodBroadcast(torch.autograd.Function):
     def forward(ctx, tensor, root_rank, name, process_set=None):
         ctx.root_rank = root_rank
         ctx.process_set = process_set
+        native, ps_id, ps_size = _native_dispatch(tensor, process_set)
+        if native:
+            nm = name if name is not None \
+                else _auto_name("torch.broadcast", None)
+            return torch.ops.hvd.broadcast(tensor, nm, int(root_rank),
+                                           ps_id, ps_size)
         return synchronize(broadcast_async(tensor, root_rank, name,
                                            process_set))
 

@@ -174,6 +174,12 @@ def test_torch_ops_3proc():
     run_torch_workers("ops", 3)
 
 
+def test_torch_native_ops():
+    """C++ dispatcher ops (torch.ops.hvd.*) serve the torch surface on
+    the native engine: correct math, autograd, torch.compile."""
+    run_torch_workers("native_ops", 2, timeout=420.0)
+
+
 @pytest.mark.parametrize("engine", ENGINES)
 def test_torch_grads(engine):
     _assert_torch_gang("grads", engine)

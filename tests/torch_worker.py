@@ -269,6 +269,56 @@ def scenario_adasum_optimizer():
         assert torch.allclose(gathered[r], gathered[0], atol=1e-6)
 
 
+def scenario_native_ops():
+    # C++ dispatcher ops (csrc/torch_ops.cc, torch.ops.hvd.*): engaged
+    # on the native engine, correct math, autograd through the custom
+    # kernel forward, torch.compile carries the op.
+    from horovod_tpu.torch import _native_ops
+
+    rank, size = hvd.rank(), hvd.size()
+    assert _native_ops.available(), "torch native ops not engaged"
+    tot = sum(r + 1.0 for r in range(size))
+
+    x = torch.arange(8, dtype=torch.float32) * (rank + 1)
+    out = hvd.allreduce(x, op=hvd.Sum, name="tn.ar")
+    assert torch.equal(out, torch.arange(8, dtype=torch.float32) * tot)
+
+    v = torch.ones(4, requires_grad=True)
+    y = hvd.allreduce(v * (rank + 1), op=hvd.Sum, name="tn.g").sum()
+    y.backward()
+    # backward allreduces the upstream ones (-> size) then scales by
+    # this rank's local factor (rank+1)
+    assert torch.allclose(
+        v.grad, torch.full((4,), float(size * (rank + 1)))), v.grad
+
+    # in-place dispatcher op reduces into the caller's storage
+    y = x.clone()
+    ret = hvd.allreduce_(y, op=hvd.Sum, name="tn.ar_")
+    assert ret.data_ptr() == y.data_ptr()
+    assert torch.equal(y, torch.arange(8, dtype=torch.float32) * tot)
+
+    b = hvd.broadcast(x, root_rank=size - 1, name="tn.bc")
+    assert torch.equal(b, torch.arange(8, dtype=torch.float32) * size)
+    rows = 0 if rank == 0 else 2
+    ag = hvd.allgather(torch.full((rows, 3), float(rank)), name="tn.ag")
+    assert ag.shape == (sum(0 if r == 0 else 2 for r in range(size)), 3)
+
+    def f(t):
+        return hvd.allreduce(t, op=hvd.Sum, name="tn.comp") * 2
+
+    cf = torch.compile(f, backend="eager")
+    assert torch.equal(cf(x),
+                       torch.arange(8, dtype=torch.float32) * tot * 2)
+
+    from horovod_tpu.process_sets import ProcessSet
+
+    ps = ProcessSet([0, size - 1])
+    if ps.included():
+        out = hvd.allreduce(torch.ones(3) * (rank + 1), op=hvd.Sum,
+                            name="tn.ps", process_set=ps)
+        assert torch.allclose(out, torch.full((3,), 1.0 + size))
+
+
 def scenario_join():
     rank, size = hvd.rank(), hvd.size()
     for b in range(rank + 1):
