@@ -3,4 +3,10 @@
 Parity: ``horovod/run/`` (horovodrun CLI, gloo_run slot allocation,
 RendezvousServer).  The TPU twist: besides ``-H host:slots`` the launcher
 can derive world topology from TPU slice metadata (see ``discovery.py``).
+
+``from horovod_tpu.runner.run import run`` is the programmatic entry
+point (parity: ``horovod.run.run``) — run a function on N ranks and
+collect per-rank results.  (Not re-exported at package level: binding
+the name ``run`` on the package would shadow the module for
+``import horovod_tpu.runner.run``.)
 """

@@ -357,7 +357,9 @@ def DistributedOptimizer(optimizer, name=None,
                          op=ReduceOp.AVERAGE,
                          backward_passes_per_step=1,
                          average_aggregated_gradients=False,
-                         process_set=None):
+                         process_set=None,
+                         device_dense="", device_sparse="",
+                         sparse_as_dense=False, use_locking=False):
     """Wraps a Keras-3 optimizer: gradients are allreduced before being
     applied (parity: tensorflow/__init__.py:266-311 — there via
     compute_gradients; Keras 3 funnels through apply_gradients).
@@ -372,6 +374,11 @@ def DistributedOptimizer(optimizer, name=None,
     as the reference) so restored slot state and the iteration counter
     survive — important when wrapping an optimizer loaded from a
     checkpoint.
+
+    ``device_dense``/``device_sparse``/``sparse_as_dense``/
+    ``use_locking`` are accepted for reference signature compatibility
+    and ignored — there are no CUDA streams or TF1 locking semantics to
+    configure on this stack.
 
     ``backward_passes_per_step=N`` aggregates gradients locally over N
     ``apply_gradients`` calls and allreduces+applies only on the Nth

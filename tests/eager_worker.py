@@ -674,11 +674,14 @@ def scenario_bridge_jit():
                 jnp.ones(5) * (rank + 1)))
         np.testing.assert_allclose(out, np.full(5, 1.0 + size))
 
-    # repeated execution of the same compiled step: same names renegotiate
-    # through the response cache, values stay correct
+    # repeated execution of the same compiled step: same names ride the
+    # response cache's fast path, values stay correct
+    hits_before = hvd.cache_stats()["hits"]
     for _ in range(3):
         w2, g_avg, _ = train_step(w)
     np.testing.assert_allclose(np.asarray(g_avg), g_eager, rtol=1e-6)
+    assert hvd.cache_stats()["hits"] > hits_before, \
+        "compiled-path tensors did not hit the response cache"
 
 
 def scenario_bridge_timeline():
