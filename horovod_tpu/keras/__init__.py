@@ -83,10 +83,19 @@ def broadcast(value, root_rank=0, name=None):
 def DistributedOptimizer(optimizer, name=None,
                          device_dense="", device_sparse="",
                          compression=None, op=ReduceOp.AVERAGE,
+                         backward_passes_per_step=1,
+                         average_aggregated_gradients=False,
                          process_set=None):
     """Wraps a Keras optimizer so gradients are allreduced across ranks
     before being applied (parity: _keras/__init__.py:20-86 — dynamic
     subclass overriding the gradient-aggregation step).
+
+    ``backward_passes_per_step=N`` aggregates gradients locally over N
+    ``apply_gradients`` calls and allreduces+applies only on the Nth
+    (intermediate calls leave variables untouched);
+    ``average_aggregated_gradients=True`` divides the local sum by N
+    before the allreduce — both exactly as on the TF surface
+    (``horovod_tpu.tensorflow.DistributedOptimizer``).
 
     Supported with the TensorFlow Keras backend, whose trainer funnels
     through ``apply_gradients``.  The JAX and torch Keras backends
@@ -104,9 +113,11 @@ def DistributedOptimizer(optimizer, name=None,
             f"horovod_tpu.torch directly.")
     hvd_tf = _tf_surface()
     comp = compression or hvd_tf.Compression.none
-    return hvd_tf.DistributedOptimizer(optimizer, name=name,
-                                       compression=comp, op=op,
-                                       process_set=process_set)
+    return hvd_tf.DistributedOptimizer(
+        optimizer, name=name, compression=comp, op=op,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        process_set=process_set)
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,
