@@ -27,12 +27,40 @@ _lib: Optional[ctypes.CDLL] = None
 
 _SOURCES = ("wire.cc", "sockets.cc", "kernels.cc", "autotune.cc",
             "timeline.cc", "engine.cc", "c_api.cc")
+# In the build only when jaxlib's FFI headers are present (the Makefile's
+# conditional SRCS) — tracked for staleness only in that configuration,
+# or _needs_build would stay True forever on FFI-off hosts.
+_FFI_SOURCE = "ffi_bridge.cc"
 _HEADERS = ("types.h", "wire.h", "sockets.h", "kernels.h", "autotune.h",
             "timeline.h", "engine.h")
+_FFI_ON_STAMP = _CSRC_DIR / ".ffi_on.stamp"
+_FFI_OFF_STAMP = _CSRC_DIR / ".ffi_off.stamp"
 
 
 class NativeUnavailable(ImportError):
     pass
+
+
+def _ffi_include_dir() -> str:
+    """jaxlib's XLA FFI header dir, located WITHOUT importing jax.
+
+    Numpy-only eager workers load this module on startup; importing jax
+    here would cost them seconds.  ``find_spec`` reads package metadata
+    only, and the header path is stable within a jaxlib install
+    (``jax.ffi.include_dir()`` resolves to the same directory).
+    """
+    try:
+        import importlib.util
+
+        spec = importlib.util.find_spec("jaxlib")
+        if spec is None or not spec.origin:
+            return ""
+        inc = Path(spec.origin).parent / "include"
+        if (inc / "xla" / "ffi" / "api" / "ffi.h").is_file():
+            return str(inc)
+    except Exception:
+        pass
+    return ""
 
 
 def _needs_build() -> bool:
@@ -40,8 +68,16 @@ def _needs_build() -> bool:
         return False  # installed artifact only; use the .so as shipped
     if not _LIB_PATH.exists():
         return True
+    # The stamps record whether the XLA FFI handlers compiled into the
+    # current .so (csrc/Makefile manages them).  If availability changed
+    # — or the lib predates the stamp mechanism — relink.
+    want_on = bool(_ffi_include_dir())
+    if want_on != _FFI_ON_STAMP.exists() or (
+            not want_on) != _FFI_OFF_STAMP.exists():
+        return True
+    sources = _SOURCES + ((_FFI_SOURCE,) if want_on else ())
     lib_mtime = _LIB_PATH.stat().st_mtime
-    for f in _SOURCES + _HEADERS:
+    for f in sources + _HEADERS:
         p = _CSRC_DIR / f
         if p.exists() and p.stat().st_mtime > lib_mtime:
             return True
@@ -49,6 +85,15 @@ def _needs_build() -> bool:
 
 
 def build_if_needed() -> None:
+    """Build libhvd_core.so via the one build recipe: ``csrc/Makefile``.
+
+    The Makefile decides whether the XLA custom-call handlers
+    (ffi_bridge.cc) compile in; this loader only supplies the header
+    location so the probe needn't import jax (the Makefile's own
+    fallback probe shells out to ``python3 -c "import jax.ffi ..."``).
+    setup.py drives the same Makefile for wheels, so lazy source builds
+    and packaged builds cannot drift.
+    """
     if not _needs_build():
         return
     _LIB_PATH.parent.mkdir(parents=True, exist_ok=True)
@@ -58,16 +103,18 @@ def build_if_needed() -> None:
         try:
             if not _needs_build():  # built while we waited on the lock
                 return
-            cmd = [
-                os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-fPIC",
-                "-Wall", "-pthread", "-shared",
-            ] + [str(_CSRC_DIR / s) for s in _SOURCES] + [
-                "-o", str(_LIB_PATH),
-            ]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            cmd = ["make", "-C", str(_CSRC_DIR),
+                   f"JAX_INC={_ffi_include_dir()}"]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            except OSError as e:
+                raise NativeUnavailable(
+                    "native core build needs the `make` binary on PATH "
+                    f"(csrc/Makefile is the one build recipe): {e}")
             if proc.returncode != 0:
                 raise NativeUnavailable(
-                    f"native core build failed:\n{proc.stderr}")
+                    f"native core build failed:\n{proc.stdout}"
+                    f"\n{proc.stderr}")
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
 

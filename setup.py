@@ -21,19 +21,20 @@ OUT = ROOT / "horovod_tpu" / "_lib" / "libhvd_core.so"
 
 
 def build_native():
-    # One build recipe: the Makefile.  The FFI-header probe result from
-    # THIS interpreter rides in via JAX_INC so wheel and hand builds
-    # cannot drift (XLA custom-call handlers compile in when jaxlib
-    # ships its headers; pure-ctypes core otherwise).
+    # One build recipe: the Makefile.  The FFI-header probe is
+    # native._ffi_include_dir() — the SAME no-import check the lazy
+    # loader and the Makefile fallback use, so wheel, lazy, and hand
+    # builds decide identically (an `import jax`-based probe here could
+    # disagree with the loader's under jax/jaxlib skew and force a
+    # stamp-mismatch relink at first import of the fresh wheel).
     OUT.parent.mkdir(parents=True, exist_ok=True)
-    jax_inc = ""
-    try:
-        import jax.ffi as _jax_ffi
+    import importlib.util as _ilu
 
-        jax_inc = _jax_ffi.include_dir()
-    except Exception:
-        pass
-    cmd = ["make", "-C", str(CSRC), f"JAX_INC={jax_inc}"]
+    spec = _ilu.spec_from_file_location(
+        "_hvd_native_build_probe", ROOT / "horovod_tpu" / "native.py")
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    cmd = ["make", "-C", str(CSRC), f"JAX_INC={mod._ffi_include_dir()}"]
     print(" ".join(cmd), file=sys.stderr)
     subprocess.run(cmd, check=True)
 

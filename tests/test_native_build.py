@@ -1,0 +1,48 @@
+"""The on-demand native build and the Makefile are one recipe.
+
+Regression: ``native.build_if_needed`` once carried its own g++ source
+list without ``ffi_bridge.cc``, so a fresh checkout built a core without
+the XLA custom-call handlers even though jaxlib's FFI headers were
+present (the jitted bridge then silently stayed on io_callback and the
+gang scenario asserting the native path failed).  The loader now drives
+``csrc/Makefile`` and relinks when FFI-header availability disagrees
+with the build stamps.
+"""
+
+import ctypes
+
+import pytest
+
+from horovod_tpu import native
+
+_ffi_available = bool(native._ffi_include_dir())
+needs_ffi_headers = pytest.mark.skipif(
+    not _ffi_available, reason="jaxlib FFI headers not present")
+
+
+@needs_ffi_headers
+def test_fresh_build_includes_ffi_handlers():
+    native.build_if_needed()
+    lib = ctypes.CDLL(str(native._LIB_PATH))
+    assert getattr(lib, "HvdGroupedAllreduce", None) is not None, (
+        "libhvd_core.so built without the XLA FFI handlers although "
+        "jaxlib headers are present")
+    # Makefile stamps must agree with what was linked in.
+    assert native._FFI_ON_STAMP.exists()
+    assert not native._FFI_OFF_STAMP.exists()
+
+
+@needs_ffi_headers
+def test_stamp_mismatch_forces_relink():
+    native.build_if_needed()
+    assert not native._needs_build()
+    # Simulate a core built by an interpreter that saw no FFI headers.
+    native._FFI_ON_STAMP.unlink(missing_ok=True)
+    native._FFI_OFF_STAMP.touch()
+    try:
+        assert native._needs_build(), (
+            "stale no-FFI core would be kept despite headers appearing")
+    finally:
+        native._FFI_OFF_STAMP.unlink(missing_ok=True)
+        native._FFI_ON_STAMP.touch()
+        assert not native._needs_build()
