@@ -87,6 +87,29 @@ def _timed_images_per_sec(step, state, images, labels, batch, iters,
     return float(np.median(img_secs)), state
 
 
+def _transformer_model_flops(cfg, batch, seq):
+    """Analytic model FLOPs per train step (fwd + 2x bwd, no remat).
+
+    XLA's ``cost_analysis()`` counts a ``lax.scan`` body ONCE, so for
+    the layer-scanned transformer it under-reports by ~n_layers and the
+    resulting "MFU" is meaningless.  Standard MFU practice (PaLM appx B)
+    counts matmul FLOPs analytically: per layer 4 attention projections
+    (2·T·D²·4), a gated FFN (3 matmuls, 2·T·D·F·3), and the attention
+    core (2 score/context matmuls, 2·2·H·B·S²·Dh), plus the vocab
+    projection — times 3 for forward + backward.
+    """
+    assert not cfg.n_experts, (
+        "analytic FLOP count assumes a dense FFN; MoE routes ~1 "
+        "expert's FLOPs per token plus router/dispatch — extend the "
+        "formula before benching an MoE config")
+    T = batch * seq
+    per_layer = (4 * 2 * T * cfg.d_model ** 2
+                 + 3 * 2 * T * cfg.d_model * cfg.d_ff
+                 + 2 * 2 * cfg.n_heads * batch * seq * seq * cfg.head_dim)
+    fwd = cfg.n_layers * per_layer + 2 * T * cfg.d_model * cfg.vocab_size
+    return 3.0 * fwd
+
+
 def _step_flops(step, state, images, labels):
     """Model FLOPs per step from XLA's cost analysis of the compiled step."""
     try:
@@ -293,7 +316,10 @@ def main() -> None:
         toks = jnp.asarray(rs.randint(0, tcfg.vocab_size, (tbatch, tseq)),
                            jnp.int32)
         tgts = jnp.roll(toks, -1, axis=1)
-        tflops = _step_flops(tstep, tstate, toks, tgts)
+        # Analytic, NOT cost_analysis: XLA counts the layer scan once
+        # (see _transformer_model_flops) — the r4 capture's 0.0678
+        # "transformer_mfu" was really ~0.44.
+        tflops = _transformer_model_flops(tcfg, tbatch, tseq)
         for _ in range(warmup_iters):
             tstate, tloss = tstep(tstate, toks, tgts)
         float(np.asarray(tloss).ravel()[0])

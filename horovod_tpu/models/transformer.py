@@ -55,6 +55,10 @@ class TransformerConfig:
     #   mesh has no tp/sp sharding to partition across (falls back to
     #   dense under GSPMD sharding, where XLA cannot split a pallas_call).
     attn_impl: str = "dense"
+    # Rematerialize each layer in the backward pass (jax.checkpoint).
+    # Costs ~1 extra forward of compute for O(1)-layer activation
+    # memory; turn off when the model fits without it.
+    remat: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -296,9 +300,11 @@ def _layer(x, lp, cfg: TransformerConfig, mesh):
 
 
 def apply(params: Params, tokens, cfg: TransformerConfig,
-          *, mesh=None, remat: bool = True):
+          *, mesh=None, remat: Optional[bool] = None):
     """Forward pass.  ``tokens``: [B, S] int32.  Returns
-    ``(logits_fp32, aux_loss)``."""
+    ``(logits_fp32, aux_loss)``.  ``remat`` defaults to ``cfg.remat``."""
+    if remat is None:
+        remat = cfg.remat
     dtype = cfg.compute_dtype
     x = params["embed"].astype(dtype)[tokens]
     x = _constrain(x, ACT_SPEC, mesh)
@@ -315,15 +321,24 @@ def apply(params: Params, tokens, cfg: TransformerConfig,
     (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                            params["layers"])
     x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                        params["embed"])
+    # Vocab projection: compute-dtype inputs on the MXU, f32
+    # accumulation (an f32xf32 dot here ran at the MXU's multi-pass
+    # fp32 rate and was the single hottest op of the step).
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        params["embed"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
     return logits, aux
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig,
             *, mesh=None, aux_weight: float = 0.01):
     logits, aux = apply(params, tokens, cfg, mesh=mesh)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.mean(jnp.take_along_axis(logp, targets[..., None],
-                                        axis=-1))
+    # logsumexp form of softmax cross-entropy: one pass over the
+    # [B, S, V] logits instead of materializing a full log_softmax
+    # tensor of the same size (identical math:
+    # -logp[target] = lse(logits) - logits[target]).
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None],
+                                       axis=-1)[..., 0]
+    nll = jnp.mean(lse - target_logit)
     return nll + aux_weight * aux
