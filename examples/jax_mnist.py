@@ -40,11 +40,20 @@ def main():
     rank, size = hvd.rank(), hvd.size()
 
     # Synthetic MNIST: a fixed linear teacher makes the loss meaningfully
-    # decreasable; each rank gets its own shard (seeded by rank).
-    rs = np.random.RandomState(1234 + rank)
+    # decreasable; one GLOBAL dataset sharded per rank by the input
+    # pipeline (the reference's DistributedSampler idiom,
+    # pytorch_imagenet_resnet50.py:112-130).
+    rs = np.random.RandomState(1234)
     images = rs.rand(4096, 28, 28, 1).astype(np.float32)
     teacher = np.random.RandomState(0).randn(28 * 28, 10)
-    labels = (images.reshape(-1, 784) @ teacher).argmax(-1)
+    labels = (images.reshape(-1, 784) @ teacher).argmax(-1).astype(np.int32)
+    dataset = hvd.data.ArrayDataset(images, labels)
+    sampler = hvd.data.ShardedSampler(len(dataset), rank, size)
+    if len(sampler) < args.batch_size:
+        raise SystemExit(
+            f"per-rank shard ({len(sampler)}) < batch size "
+            f"({args.batch_size}): no full batch per epoch — lower "
+            "--batch-size or run fewer processes")
 
     params = mnist_model.init(jax.random.PRNGKey(0))
 
@@ -56,19 +65,30 @@ def main():
         lambda prm, x, y: mnist_model.loss_fn(prm, x, y)))
 
     t0 = time.time()
-    for step in range(args.steps):
-        idx = rs.randint(0, len(images), args.batch_size)
-        loss, grads = grad_fn(params, jnp.asarray(images[idx]),
-                              jnp.asarray(labels[idx]))
-        # Horovod idiom #2: average gradients across ranks
-        # (axis=None selects the eager multi-process path).
-        grads = hvd.allreduce_gradients(grads, axis=None)
-        params = jax.tree.map(lambda p, g: p - args.lr * g, params, grads)
-        if step % 50 == 0:
-            avg = hvd.allreduce(np.asarray(loss), op=hvd.Average,
-                                name="metric.loss")
-            if rank == 0:
-                print(f"step {step}: loss {float(avg):.4f}")
+    step = 0
+    epoch = 0
+    while step < args.steps:
+        sampler.set_epoch(epoch)
+        epoch += 1
+        # prefetch_to_device overlaps the next batch's host->device
+        # transfer with the current step's compute.
+        for xb, yb in hvd.data.prefetch_to_device(
+                hvd.data.batches(dataset, sampler, args.batch_size)):
+            loss, grads = grad_fn(params, xb, yb)
+            # Horovod idiom #2: average gradients across ranks
+            # (axis=None selects the eager multi-process path).
+            grads = hvd.allreduce_gradients(grads, axis=None)
+            params = jax.tree.map(lambda p, g: p - args.lr * g,
+                                  params, grads)
+            if step % 50 == 0:
+                avg = hvd.allreduce(np.asarray(loss), op=hvd.Average,
+                                    name="metric.loss")
+                if rank == 0:
+                    avg = float(np.asarray(avg).ravel()[0])
+                    print(f"step {step}: loss {avg:.4f}")
+            step += 1
+            if step >= args.steps:
+                break
     if rank == 0:
         rate = args.steps * args.batch_size * size / (time.time() - t0)
         print(f"done: {rate:.0f} images/sec across {size} process(es)")

@@ -84,6 +84,7 @@ from horovod_tpu.parallel.optimizer import (  # noqa: F401
     distributed_grad,
     distributed_value_and_grad,
 )
+from horovod_tpu import data  # noqa: F401  (sharded sampling + prefetch)
 
 # ReduceOp constants at top level, Horovod-style (basics.py:29-31).
 Average = ReduceOp.AVERAGE
