@@ -292,10 +292,20 @@ Engine::~Engine() { Shutdown(); }
 
 void Engine::Shutdown() {
   bool expected = false;
-  if (!shutdown_.compare_exchange_strong(expected, true)) {
+  if (!shutdown_requested_.compare_exchange_strong(expected, true)) {
     if (bg_.joinable()) bg_.join();
     return;
   }
+  // Negotiated shutdown (parity: controller.cc:116-130 — the shutdown
+  // flag rides RequestList/ResponseList): the loop tells the
+  // coordinator, whose next ResponseList stops every rank in the same
+  // cycle, so no rank reads a socket its peer already closed.  Bounded:
+  // if negotiation can't complete (peer already gone), force the local
+  // loop down after the deadline.
+  double deadline = NowS() + 10.0;
+  while (!loop_exited_.load() && NowS() < deadline)
+    std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  shutdown_.store(true);
   if (bg_.joinable()) bg_.join();
   timeline_.Shutdown();
   for (int fd : data_fds_)
@@ -326,7 +336,7 @@ void Engine::ReleaseName(const std::string& name) {
 
 int64_t Engine::Enqueue(TensorTableEntry entry, std::string* err) {
   std::lock_guard<std::mutex> lk(queue_mu_);
-  if (aborted_.load() || shutdown_.load()) {
+  if (aborted_.load() || shutdown_.load() || shutdown_requested_.load()) {
     *err = "horovod_tpu runtime has been shut down";
     return -1;
   }
@@ -580,11 +590,15 @@ void Engine::BackgroundLoop() {
       }
     }
   } catch (const std::exception& e) {
-    std::fprintf(stderr, "[hvd-core %d] background loop failed: %s\n",
-                 cfg_.rank, e.what());
+    // A peer closing its socket during an agreed teardown is part of
+    // shutting down, not a failure worth alarming about.
+    if (!shutdown_requested_.load() && !shutdown_.load())
+      std::fprintf(stderr, "[hvd-core %d] background loop failed: %s\n",
+                   cfg_.rank, e.what());
     Abort(e.what());
   }
   DrainOnShutdown();
+  loop_exited_.store(true);
 }
 
 void Engine::DrainOnShutdown() {
@@ -692,9 +706,19 @@ bool Engine::WorkerCycle(std::vector<Request> msgs) {
   std::vector<Request> requests;
   std::vector<CacheHit> hit_events;
   ClassifyRequests(std::move(msgs), &requests, &hit_events);
-  if (!requests.empty() || !hit_events.empty()) {
-    auto payload = EncodeRequestList(requests, /*shutdown=*/false, hit_events);
-    SendFrame(ctrl, kTagRequestList, payload.data(), payload.size());
+  bool want_shutdown = shutdown_requested_.load();
+  bool send_failed = false;
+  if (!requests.empty() || !hit_events.empty() || want_shutdown) {
+    auto payload = EncodeRequestList(requests, want_shutdown, hit_events);
+    try {
+      SendFrame(ctrl, kTagRequestList, payload.data(), payload.size());
+    } catch (const SocketError&) {
+      // The coordinator may have closed right after broadcasting a
+      // shutdown ResponseList; that frame can still be buffered on our
+      // side — fall through to the drain, which exits gracefully on
+      // it.  Only if no shutdown was in flight is this a real failure.
+      send_failed = true;
+    }
   }
   while (Readable(ctrl, 0)) {
     std::vector<uint8_t> payload;
@@ -721,6 +745,8 @@ bool Engine::WorkerCycle(std::vector<Request> msgs) {
       return false;
     }
   }
+  if (send_failed)  // no shutdown was in flight: genuine lost peer
+    throw SocketError("lost connection to coordinator");
   return true;
 }
 
@@ -769,7 +795,7 @@ void Engine::AbsorbRequest(const Request& req,
 
 bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
   std::vector<std::string> ready;
-  bool shutdown = false;
+  bool shutdown = shutdown_requested_.load();
   std::map<int, std::vector<std::string>> resend_by_rank;
 
   auto absorb_hit = [&](const std::string& name, uint32_t pos, int rank) {

@@ -317,6 +317,11 @@ class Engine {
   std::vector<uint8_t> fusion_buffer_;
 
   std::atomic<bool> shutdown_{false};
+  // Set by Shutdown(): the loop negotiates the stop through the
+  // controller (RequestList/ResponseList shutdown bits) so every rank
+  // exits in the same cycle instead of closing sockets under a peer.
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> loop_exited_{false};
   std::atomic<bool> aborted_{false};
   std::atomic<int64_t> barrier_counter_{0};
   std::mutex process_sets_mu_;

@@ -331,8 +331,14 @@ class PyEngine(_EngineBase):
         self._join_handle: Optional[int] = None
         self._last_joined_rank = -1
 
-        # shutdown
+        # shutdown: `_shutdown_requested` asks the loop to negotiate the
+        # stop through the controller (shutdown bits on the wire) so all
+        # ranks exit in the same cycle; `_shutdown_flag` is the hard
+        # local stop; `_loop_exited` lets shutdown() bound its wait.
+        self._shutdown_requested = threading.Event()
         self._shutdown_flag = threading.Event()
+        self._loop_exited = threading.Event()
+        self._closed = False  # shutdown() ran its cleanup (socket close)
         self._aborted = False
 
         # coordinator state
@@ -417,7 +423,8 @@ class PyEngine(_EngineBase):
     # ------------------------------------------------------------------
 
     def _enqueue(self, entry: TensorTableEntry) -> int:
-        if self._aborted or self._shutdown_flag.is_set():
+        if self._aborted or self._shutdown_flag.is_set() \
+                or self._shutdown_requested.is_set():
             raise RuntimeError("horovod_tpu runtime has been shut down")
         self._claim_name(entry.name)
         with self._queue_lock:
@@ -582,8 +589,20 @@ class PyEngine(_EngineBase):
         return self._last_joined_rank
 
     def shutdown(self):
-        if self._shutdown_flag.is_set():
+        # Cleanup must run exactly once — but it must run even when the
+        # loop was already stopped by a PEER's negotiated shutdown (the
+        # normal case on every non-initiating rank), so the guard is a
+        # dedicated cleanup flag, not the loop-stop flags.
+        if self._closed:
             return
+        self._closed = True
+        # Negotiated shutdown (parity: controller.cc:116-130): the next
+        # worker/coordinator cycle carries the shutdown bit, the
+        # coordinator's ResponseList stops every rank in the same cycle,
+        # and only then do sockets close — no rank reads a socket its
+        # peer already closed.  Bounded in case peers are already gone.
+        self._shutdown_requested.set()
+        self._loop_exited.wait(timeout=10)
         self._shutdown_flag.set()
         self._bg.join(timeout=10)
         self.timeline.shutdown()
@@ -613,10 +632,13 @@ class PyEngine(_EngineBase):
                 if dt < self.cycle_time:
                     time.sleep(self.cycle_time - dt)
         except Exception as e:  # deliver failure to all pending handles
-            self.log.error("background loop failed: %r", e)
+            if not (self._shutdown_requested.is_set()
+                    or self._shutdown_flag.is_set()):
+                self.log.error("background loop failed: %r", e)
             self._abort(str(e))
         finally:
             self._drain_on_shutdown()
+            self._loop_exited.set()
 
     def _drain_on_shutdown(self):
         # Parity: SHUT_DOWN_ERROR delivered to pending callbacks
@@ -710,14 +732,20 @@ class PyEngine(_EngineBase):
 
     def _worker_cycle(self, msgs: List[Request]) -> bool:
         requests, hit_events = self._classify(msgs)
-        if requests or hit_events:
-            payload = wire.encode_request_list(requests, shutdown=False,
+        want_shutdown = self._shutdown_requested.is_set()
+        send_failed = False
+        if requests or hit_events or want_shutdown:
+            payload = wire.encode_request_list(requests,
+                                               shutdown=want_shutdown,
                                                cache_hits=hit_events)
             try:
                 su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
             except (ConnectionError, OSError):
-                self._abort("lost connection to coordinator")
-                return False
+                # The coordinator may have closed right after
+                # broadcasting a shutdown ResponseList; the receiver
+                # thread may already hold it — drain before concluding
+                # the peer was genuinely lost.
+                send_failed = True
         with self._response_lock:
             inbox = self._response_inbox
             self._response_inbox = []
@@ -736,6 +764,9 @@ class PyEngine(_EngineBase):
             if shutdown:
                 self._shutdown_flag.set()
                 return False
+        if send_failed:  # no shutdown in flight: genuine lost peer
+            self._abort("lost connection to coordinator")
+            return False
         return True
 
     def _apply_params(self, params) -> None:
@@ -759,7 +790,7 @@ class PyEngine(_EngineBase):
 
     def _coordinator_cycle(self, msgs: List[Request]) -> bool:
         ready: List[str] = []
-        shutdown = False
+        shutdown = self._shutdown_requested.is_set()
         # names this cycle asks specific ranks to resend in full
         resend_by_rank: Dict[int, List[str]] = {}
 

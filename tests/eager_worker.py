@@ -524,6 +524,46 @@ def scenario_barrier():
         hvd.barrier()
 
 
+def scenario_staggered_shutdown():
+    """Ranks call shutdown() at staggered times.  The negotiated
+    shutdown (shutdown bits on the controller wire) must stop every
+    rank's loop in the same cycle — before the fix, whichever rank shut
+    down first closed its sockets under its peers and the survivors
+    printed "background loop failed: peer closed connection" (the test
+    asserts on worker stderr)."""
+    import time
+
+    x = np.arange(8, dtype=np.float32) + hvd.rank()
+    out = hvd.allreduce(x, name="stagger.warm", op=hvd.Sum)
+    expect = (np.arange(8, dtype=np.float32) * hvd.size()
+              + sum(range(hvd.size())))
+    np.testing.assert_allclose(out, expect)
+    time.sleep(0.3 * hvd.rank())
+    hvd.shutdown()
+
+
+def scenario_shutdown_under_traffic():
+    """The coordinator rank shuts down while workers have collectives in
+    flight.  The workers' pending handles must resolve (aborted, raising
+    from the blocked wait), their loops must exit through the negotiated
+    shutdown rather than a socket error, and the send-before-drain
+    window (worker writes its RequestList to a coordinator that closed
+    right after broadcasting shutdown) must stay quiet."""
+    if hvd.rank() == 0:
+        hvd.shutdown()
+        return
+    i = 0
+    while True:
+        try:
+            hvd.allreduce(np.ones(64, np.float32), name=f"sut.{i}",
+                          op=hvd.Sum)
+        except Exception:
+            break  # pending handle aborted by the drain — expected
+        i += 1
+        assert i < 10000, "shutdown never reached the workers"
+    hvd.shutdown()
+
+
 def scenario_resume_or_init():
     # Fresh init path of the checkpoint helper: per-rank-divergent init
     # must come out rank-0-agreed on every rank (broadcast-at-start).

@@ -294,6 +294,29 @@ def test_join(engine):
 
 
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_staggered_shutdown_is_quiet(engine):
+    """Uncoordinated shutdown() timing must not surface socket errors —
+    the stop is negotiated through the controller so every rank's loop
+    exits in the same cycle (isolated gang: the scenario tears the
+    engine down)."""
+    outs = run_workers("staggered_shutdown", 4, engine=engine)
+    for rank, (code, out, err) in enumerate(outs):
+        assert "background loop failed" not in err, (rank, err)
+        assert "background loop failed" not in out, (rank, out)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
+def test_shutdown_under_traffic_is_quiet(engine):
+    """Coordinator-initiated shutdown with worker collectives in flight:
+    pending handles resolve, no socket-error noise (the send-before-
+    drain window)."""
+    outs = run_workers("shutdown_under_traffic", 4, engine=engine)
+    for rank, (code, out, err) in enumerate(outs):
+        assert "background loop failed" not in err, (rank, err)
+        assert "background loop failed" not in out, (rank, out)
+
+
+@pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 def test_barrier(engine):
     # mixed included: the barrier name must be engine-independent
     # (a dedicated barrier counter in both engines, not the handle
