@@ -321,24 +321,30 @@ def apply(params: Params, tokens, cfg: TransformerConfig,
     (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                            params["layers"])
     x = _rmsnorm(x, params["ln_f"])
-    # Vocab projection: compute-dtype inputs on the MXU, f32
-    # accumulation (an f32xf32 dot here ran at the MXU's multi-pass
-    # fp32 rate and was the single hottest op of the step).
-    logits = jnp.einsum("bsd,vd->bsv", x,
-                        params["embed"].astype(x.dtype),
-                        preferred_element_type=jnp.float32)
-    return logits, aux
+    return vocab_projection(x, params["embed"]), aux
+
+
+def vocab_projection(x, embed):
+    """Final [B,S,D] → [B,S,V] projection: compute-dtype inputs on the
+    MXU, f32 accumulation (an f32xf32 dot here ran at the MXU's
+    multi-pass fp32 rate and was the single hottest op of the step).
+    Shared with the pipelined path (parallel/pipeline.py)."""
+    return jnp.einsum("bsd,vd->bsv", x, embed.astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def softmax_xent(logits, targets):
+    """Mean softmax cross-entropy in logsumexp form: one pass over the
+    [B, S, V] logits instead of materializing a full log_softmax tensor
+    of the same size (identical math:
+    -logp[target] = lse(logits) - logits[target])."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None],
+                                       axis=-1)[..., 0]
+    return jnp.mean(lse - target_logit)
 
 
 def loss_fn(params, tokens, targets, cfg: TransformerConfig,
             *, mesh=None, aux_weight: float = 0.01):
     logits, aux = apply(params, tokens, cfg, mesh=mesh)
-    # logsumexp form of softmax cross-entropy: one pass over the
-    # [B, S, V] logits instead of materializing a full log_softmax
-    # tensor of the same size (identical math:
-    # -logp[target] = lse(logits) - logits[target]).
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    target_logit = jnp.take_along_axis(logits, targets[..., None],
-                                       axis=-1)[..., 0]
-    nll = jnp.mean(lse - target_logit)
-    return nll + aux_weight * aux
+    return softmax_xent(logits, targets) + aux_weight * aux

@@ -164,9 +164,7 @@ def pipeline_apply(params, tokens, cfg: tfm.TransformerConfig, mesh,
         aux = aux / M
         x = out.reshape(B, S, D)
         x = tfm._rmsnorm(x, params["ln_f"])
-        logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
-                            params["embed"])
-        return logits, aux
+        return tfm.vocab_projection(x, params["embed"]), aux
 
     specs = pipeline_param_specs(cfg)
     # Only pp placement is named here; dp/tp/ep stay GSPMD-auto.
@@ -187,9 +185,7 @@ def pipeline_loss_fn(params, tokens, targets, cfg, mesh,
                      *, n_microbatches=None, aux_weight: float = 0.01):
     logits, aux = pipeline_apply(params, tokens, cfg, mesh,
                                  n_microbatches=n_microbatches)
-    logp = jax.nn.log_softmax(logits)
-    nll = -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
-    return nll + aux_weight * aux
+    return tfm.softmax_xent(logits, targets) + aux_weight * aux
 
 
 class PipelineTrainState(NamedTuple):
