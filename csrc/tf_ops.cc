@@ -251,6 +251,68 @@ class HvdAllgatherOp : public OpKernel {
   int ps_id_ = 0, ps_size_ = 0;
 };
 
+class HvdGroupedAllreduceOp : public OpKernel {
+ public:
+  explicit HvdGroupedAllreduceOp(OpKernelConstruction* c) : OpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &name_));
+    OP_REQUIRES_OK(c, c->GetAttr("reduce_op", &op_));
+    OP_REQUIRES_OK(c, c->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(c, c->GetAttr("postscale_factor", &postscale_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_id", &ps_id_));
+    OP_REQUIRES_OK(c, c->GetAttr("process_set_size", &ps_size_));
+  }
+
+  void Compute(OpKernelContext* ctx) override {
+    // One node submits EVERY tensor before waiting on any: a rank's
+    // submission set is atomic, so executor scheduling order cannot
+    // block two ranks inside different tensors' collectives (the
+    // deadlock the per-tensor synchronous kernels admit under small
+    // thread pools), and the engine sees all entries pending at once —
+    // full coordinator fusion, like the hook-driven torch path.
+    auto* eng = EngineOrError(ctx);
+    if (eng == nullptr) return;
+    const int n = ctx->num_inputs();
+    std::vector<int64_t> handles;
+    handles.reserve(n);
+    for (int i = 0; i < n; ++i) {
+      const Tensor& in = ctx->input(i);
+      Tensor* out = nullptr;
+      OP_REQUIRES_OK(ctx, ctx->allocate_output(i, in.shape(), &out));
+      hvd::DataType dt;
+      OP_REQUIRES(ctx, MapDtype(in.dtype(), &dt),
+                  tensorflow::errors::InvalidArgument(
+                      "unsupported dtype for engine grouped allreduce"));
+      std::memcpy(const_cast<char*>(out->tensor_data().data()),
+                  in.tensor_data().data(), in.tensor_data().size());
+      std::string err;
+      // Same wire naming as the eager/bridge grouped surface
+      // ("{base}.{i}") so mixed gangs align.
+      int64_t h = eng->EnqueueAllreduce(
+          name_ + "." + std::to_string(i),
+          const_cast<char*>(out->tensor_data().data()), ShapeOf(in), dt,
+          static_cast<hvd::ReduceOp>(op_), prescale_, postscale_, &err,
+          ps_id_, ps_size_);
+      if (h < 0) {
+        for (int64_t prior : handles) {
+          eng->handles().Wait(prior);
+          eng->handles().Release(prior);
+        }
+        ctx->SetStatus(tensorflow::errors::Internal(err));
+        return;
+      }
+      handles.push_back(h);
+    }
+    bool ok = true;
+    for (int64_t h : handles) ok = WaitHandle(ctx, eng, h) && ok;
+  }
+
+ private:
+  std::string name_;
+  int op_ = 1;
+  float prescale_ = 1.0f, postscale_ = 1.0f;
+  int ps_id_ = 0, ps_size_ = 0;
+};
+
 }  // namespace
 
 REGISTER_OP("HvdAllreduce")
@@ -302,8 +364,27 @@ REGISTER_OP("HvdAllgather")
       return tensorflow::OkStatus();
     });
 
+REGISTER_OP("HvdGroupedAllreduce")
+    .Input("tensors: T")
+    .Output("sums: T")
+    .Attr("T: list({float32, float64, half, bfloat16, int32, int64, "
+          "uint8, int8, bool})")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 1")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("process_set_id: int = 0")
+    .Attr("process_set_size: int = 0")
+    .SetShapeFn([](tensorflow::shape_inference::InferenceContext* c) {
+      for (int i = 0; i < c->num_inputs(); ++i)
+        c->set_output(i, c->input(i));
+      return tensorflow::OkStatus();
+    });
+
 REGISTER_KERNEL_BUILDER(Name("HvdAllreduce").Device(DEVICE_CPU),
                         HvdAllreduceOp);
+REGISTER_KERNEL_BUILDER(Name("HvdGroupedAllreduce").Device(DEVICE_CPU),
+                        HvdGroupedAllreduceOp);
 REGISTER_KERNEL_BUILDER(Name("HvdBroadcast").Device(DEVICE_CPU),
                         HvdBroadcastOp);
 REGISTER_KERNEL_BUILDER(Name("HvdAllgather").Device(DEVICE_CPU),

@@ -130,3 +130,13 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
         model.optimizer = DistributedOptimizer(model.optimizer,
                                                compression=compression)
     return model
+
+
+def __getattr__(name):
+    # ``Compression`` must be the TF-surface compressor (it handles
+    # tf.Tensors; the base ops.compression one is numpy/JAX and crashes
+    # on them) — resolved lazily so importing this module stays valid
+    # on non-TF Keras backends.  Parity: reference keras/__init__.py:28.
+    if name == "Compression":
+        return _tf_surface().Compression
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -106,11 +106,17 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
     if isinstance(tensor, tf.IndexedSlices):
         # Sparse gradient path: allgather values and indices (over the
         # process set when given — a silently-global gather would
-        # deadlock set members against non-members).
+        # deadlock set members against non-members).  The indices gather
+        # is control-chained behind the values gather: both kernels are
+        # synchronous, so two ranks whose executors pick opposite orders
+        # for these independent nodes would block each other forever
+        # (see grouped_allreduce).
         values = allgather(tensor.values, name=f"{name}.values"
                            if name else None, process_set=process_set)
-        indices = allgather(tensor.indices, name=f"{name}.indices"
-                            if name else None, process_set=process_set)
+        with tf.control_dependencies([values]):
+            indices = allgather(tensor.indices, name=f"{name}.indices"
+                                if name else None,
+                                process_set=process_set)
         rop = _resolve_op(op, average)
         if rop == ReduceOp.AVERAGE:
             values = values / (process_set.size()
@@ -146,6 +152,86 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
         return y, grad
 
     return compression.decompress(_fn(compressed), ctx)
+
+
+def grouped_allreduce(tensors, average=None, name=None,
+                      compression=Compression.none, op=None,
+                      process_set=None):
+    """Allreduce a list of dense tensors through ONE graph node that
+    submits every tensor to the engine before waiting on any result.
+
+    This is not just a fusion aid — it is the deadlock-safe way to
+    reduce a set of gradients.  The per-tensor collective kernels are
+    synchronous (py_function and csrc/tf_ops.cc both enqueue-and-wait),
+    and TF executes independent graph nodes in arbitrary,
+    scheduler-dependent order: under a small executor thread pool two
+    ranks can each block inside a *different* tensor's collective and
+    starve the submissions the peer is waiting for (observed as the
+    stall inspector reporting e.g. ``do.2 ready on [1]`` / ``do.4 ready
+    on [0]`` forever).  One grouped node makes each rank's submission
+    set atomic, so scheduling order cannot split it.  (The reference
+    grew ``hvd.grouped_allreduce`` one release after v0.19 for the
+    fusion half of this story.)
+
+    Differentiable: the gradient is the grouped allreduce of the
+    upstream gradients under the same op (the grouped twin of
+    ``allreduce``'s registered gradient).
+    """
+    if not tensors:
+        return []
+    rop = _resolve_op(op, average)
+    base = _auto_name("tf.grouped_allreduce", name)
+    xs = [tf.convert_to_tensor(t) for t in tensors]
+    comp = [compression.compress(x) for x in xs]
+    cxs = [c for c, _ in comp]
+
+    @tf.custom_gradient
+    def _fn(*cxs):
+        from horovod_tpu.tensorflow import _native_ops
+
+        nlib, ps_id, ps_size = _native_kernels(cxs[0], process_set)
+        if nlib is not None and hasattr(nlib, "hvd_grouped_allreduce") \
+                and all(c.dtype.name in _native_ops.SUPPORTED_DTYPES
+                        for c in cxs):
+            # One variadic C++ kernel: enqueue-all-then-wait inside the
+            # op (csrc/tf_ops.cc::HvdGroupedAllreduceOp) — same
+            # atomic-submission guarantee, no py_function/numpy hop.
+            ys = nlib.hvd_grouped_allreduce(
+                list(cxs), tensor_name=base, reduce_op=int(rop),
+                process_set_id=ps_id, process_set_size=ps_size)
+        else:
+            def _py(*arrs):
+                outs = _eager.grouped_allreduce(
+                    [a.numpy() for a in arrs], op=rop, name=base,
+                    process_set=process_set)
+                return list(outs)
+
+            ys = tf.py_function(_py, list(cxs), [c.dtype for c in cxs])
+        if len(cxs) == 1:
+            ys = [ys] if tf.is_tensor(ys) else list(ys)
+        fixed = []
+        for y, cx in zip(ys, cxs):
+            # The engine flattens 0-d scalars to shape (1,); restore.
+            y = tf.reshape(y, tf.shape(cx))
+            y.set_shape(cx.shape)
+            fixed.append(y)
+
+        def grad(*dys):
+            # An unused output arrives as dy=None; it must still ride
+            # the grouped collective (every rank submits the same set),
+            # so substitute zeros.
+            dys = [tf.zeros_like(cx) if d is None else d
+                   for d, cx in zip(dys, cxs)]
+            return grouped_allreduce(dys, op=rop, name=f"{base}.grad",
+                                     process_set=process_set)
+
+        return tuple(fixed), grad
+
+    ys = _fn(*cxs)
+    if tf.is_tensor(ys):
+        ys = [ys]
+    return [compression.decompress(y, ctx)
+            for y, (_, ctx) in zip(ys, comp)]
 
 
 def allgather(tensor, name=None, process_set=None):
@@ -309,6 +395,38 @@ def BroadcastGlobalVariablesHook(root_rank=0, device=""):
         "BroadcastGlobalVariablesCallback with model.fit().")
 
 
+def _reduce_gradients(grads, base, op, compression, process_set):
+    """Shared gradient-reduction path for the optimizer and tape
+    wrappers: dense gradients ride one grouped submission (deadlock-safe
+    and coordinator-fusible, see ``grouped_allreduce``); sparse
+    IndexedSlices follow, control-chained behind the dense results and
+    each other so every blocking collective node has the same total
+    order on every rank.  ``None`` gradients pass through."""
+    reduced = list(grads)
+    dense_ix = [i for i, g in enumerate(grads)
+                if g is not None and not isinstance(g, tf.IndexedSlices)]
+    if dense_ix:
+        douts = grouped_allreduce(
+            [grads[i] for i in dense_ix], op=op, compression=compression,
+            name=base, process_set=process_set)
+        for i, o in zip(dense_ix, douts):
+            reduced[i] = o
+    anchor = [reduced[dense_ix[-1]]] if dense_ix else []
+    for i, g in enumerate(grads):
+        if g is None or not isinstance(g, tf.IndexedSlices):
+            continue
+        with tf.control_dependencies(anchor):
+            reduced[i] = allreduce(g, op=op, compression=compression,
+                                   name=f"{base}.{i}",
+                                   process_set=process_set)
+        # Anchor on the LAST collective of this sparse gradient (the
+        # indices gather, which is itself chained behind the values
+        # gather) — anchoring on .values would leave indices(i) and
+        # values(i+1) mutually unordered, the deadlock shape again.
+        anchor = [reduced[i].indices]
+    return reduced
+
+
 class DistributedGradientTape:
     """Wraps a ``tf.GradientTape`` so ``gradient()`` allreduces the
     results (parity: tensorflow/__init__.py:474-531 — same wrap-an-
@@ -343,12 +461,8 @@ class DistributedGradientTape:
         single = not isinstance(grads, (list, tuple))
         if single:
             grads = [grads]
-        reduced = [
-            allreduce(g, op=self._op, compression=self._compression,
-                      name=f"dgt.{i}",
-                      process_set=self._process_set)
-            if g is not None else None
-            for i, g in enumerate(grads)]
+        reduced = _reduce_gradients(grads, "dgt", self._op,
+                                    self._compression, self._process_set)
         return reduced[0] if single else reduced
 
 
@@ -410,12 +524,15 @@ def DistributedOptimizer(optimizer, name=None,
                 tvars = [v for _, v in gv]
                 starts = [tf.identity(v) for v in tvars]
                 result = super().apply_gradients(gv, *args, **kwargs)
-                for i, (v, s) in enumerate(zip(tvars, starts)):
-                    delta = tf.convert_to_tensor(v) - s
-                    compressed, ctx = _compression.compress(delta)
-                    d = allreduce(compressed, op=ReduceOp.ADASUM,
-                                  name=f"adasum.delta.{i}")
-                    v.assign(s + _compression.decompress(d, ctx))
+                # One grouped submission for all deltas — same deadlock
+                # rationale as the Sum/Average path (grouped_allreduce).
+                deltas = [tf.convert_to_tensor(v) - s
+                          for v, s in zip(tvars, starts)]
+                reduced = grouped_allreduce(
+                    deltas, op=ReduceOp.ADASUM, name="adasum.delta",
+                    compression=_compression)
+                for v, s, d in zip(tvars, starts, reduced):
+                    v.assign(s + d)
                 return result
 
         _WrappedAdasum.__name__ = f"DistributedAdasum{base_cls.__name__}"
@@ -430,11 +547,8 @@ def DistributedOptimizer(optimizer, name=None,
             tvars = [v for _, v in grads_and_vars]
 
             def _reduce_apply(gs):
-                reduced = [
-                    allreduce(g, op=_op, compression=_compression,
-                              name=f"do.{i}", process_set=_ps)
-                    if g is not None else None
-                    for i, g in enumerate(gs)]
+                reduced = _reduce_gradients(
+                    gs, "do", _op, _compression, _ps)
                 return sup.apply_gradients(
                     zip(reduced, tvars), *args, **kwargs)
 

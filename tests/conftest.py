@@ -60,6 +60,7 @@ _SLOW_NODEIDS = (
     "test_examples.py::test_pytorch_spark_mnist_example",
     "test_examples.py::test_keras_spark_mnist_example",
     "test_examples.py::test_pytorch_imagenet_resnet50_2proc",
+    "test_examples.py::test_keras_imagenet_resnet50_2proc",
     "test_examples.py::test_scaling_benchmark_virtual_mesh",
     "test_examples.py::test_jax_transformer_lm_3axis",
     "test_tf_keras_binding.py::test_tf_ops",

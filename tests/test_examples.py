@@ -185,6 +185,24 @@ def test_pytorch_imagenet_resnet50_2proc(tmp_path):
     assert os.path.exists(ckpt.format(epoch=0))
 
 
+def test_keras_imagenet_resnet50_single():
+    out = run_example(
+        "keras_imagenet_resnet50.py", 1,
+        ["--epochs", "1", "--samples", "16", "--image-size", "32"],
+        timeout=420)
+    assert "final loss" in out
+
+
+def test_keras_imagenet_resnet50_2proc():
+    # Full ResNet-50 through the host allreduce at 2 ranks — heavy on
+    # one CPU core, so full-matrix only.
+    out = run_example(
+        "keras_imagenet_resnet50.py", 2,
+        ["--epochs", "1", "--samples", "16", "--image-size", "32"],
+        timeout=1100)
+    assert "final loss" in out
+
+
 def test_keras_mnist_2proc():
     out = run_example("keras_mnist.py", 2,
                       ["--epochs", "2", "--samples", "256",

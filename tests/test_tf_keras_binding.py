@@ -92,6 +92,15 @@ def test_tf_backward_passes_per_step():
     run_tf_workers("backward_passes", 2)
 
 
+def test_tf_single_thread_optimizer():
+    # Deadlock regression: synchronous collective kernels + 1 executor
+    # thread + per-rank-different node schedules.  The optimizer's
+    # grouped gradient submission keeps the ranks' submission sets
+    # atomic (pre-fix this shape hung with the stall inspector showing
+    # different do.N names ready on different ranks).
+    run_tf_workers("single_thread_optimizer", 2)
+
+
 def test_tf_adasum_optimizer_golden():
     # Delta-model Adasum wrapper at 4 ranks vs the numpy VHDD oracle,
     # through apply_gradients (ref tensorflow/__init__.py:313-407).

@@ -143,6 +143,88 @@ def scenario_tape():
     tape = hvd.DistributedGradientTape(inner)
     (grad,) = tape.gradient(loss, [w])
     np.testing.assert_allclose(grad.numpy(), expect)
+    # Reduced gradients stay differentiable (the grouped path carries a
+    # custom gradient): d/dw sum(G·w) with G = AR_avg(2w·(rank+1))
+    # = G + 2(rank+1)·AR_avg(w) = (2·mean(r+1) + 2(rank+1))·w.
+    m = np.mean([r + 1.0 for r in range(size)])
+    with tf.GradientTape() as outer:
+        with hvd.DistributedGradientTape() as dtape:
+            loss = tf.reduce_sum(w * w) * (rank + 1.0)
+        (g,) = dtape.gradient(loss, [w])
+        outer_loss = tf.reduce_sum(g * w)
+    (gg,) = outer.gradient(outer_loss, [w])
+    np.testing.assert_allclose(
+        gg.numpy(), (2.0 * m + 2.0 * (rank + 1.0)) * w.numpy(),
+        rtol=1e-5)
+
+
+def scenario_single_thread_optimizer():
+    """Deadlock regression (grouped gradient submission).
+
+    With synchronous collective kernels, a single-threaded TF executor
+    runs independent per-gradient allreduce nodes in arbitrary per-rank
+    order; two ranks could block inside different tensors' collectives
+    forever (stall inspector: "do.2 ready on [1]" / "do.4 ready on
+    [0]").  The optimizer now submits all dense gradients through ONE
+    grouped node, which this scenario exercises under the adversarial
+    executor config (1 inter-op thread, rank-asymmetric graph so the
+    schedules genuinely differ)."""
+    tf.config.threading.set_inter_op_parallelism_threads(1)
+    tf.config.threading.set_intra_op_parallelism_threads(1)
+    rank, size = hvd.rank(), hvd.size()
+    tvars = [tf.Variable(tf.ones([8]) * (i + 1.0)) for i in range(6)]
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.1), op=hvd.Sum)
+
+    @tf.function
+    def step():
+        with tf.GradientTape() as tape:
+            # Rank-asymmetric extra work so node schedules differ.
+            parts = []
+            for i, v in enumerate(tvars):
+                t = tf.reduce_sum(v * (rank + 1.0))
+                if (i + rank) % 2:
+                    t = t + tf.reduce_sum(tf.sin(v)) * 0.0
+                parts.append(t)
+            loss = tf.add_n(parts)
+        grads = tape.gradient(loss, tvars)
+        opt.apply_gradients(zip(grads, tvars))
+        return loss
+
+    for _ in range(3):
+        step()
+    # Sum op over ranks: each step subtracts lr * sum(rank+1) from
+    # every element.
+    total = sum(r + 1.0 for r in range(size))
+    expect = 1.0 - 3 * 0.1 * total
+    np.testing.assert_allclose(tvars[0].numpy(), np.full(8, expect),
+                               rtol=1e-5)
+
+    # Mixed dense + TWO sparse (IndexedSlices) gradients on the same
+    # single-thread executor: the sparse collectives must form one
+    # total order across ranks (values(i) → indices(i) → values(i+1))
+    # or indices(i)/values(i+1) deadlock ranks against each other.
+    emb1 = tf.Variable(tf.ones([16, 4]))
+    emb2 = tf.Variable(tf.ones([16, 4]))
+    dense = tf.Variable(tf.ones([4]))
+    sopt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.1), op=hvd.Sum)
+
+    @tf.function
+    def sparse_step():
+        with tf.GradientTape() as tape:
+            ids = tf.constant([rank % 16, (rank + 3) % 16])
+            loss = (tf.reduce_sum(tf.gather(emb1, ids))
+                    + tf.reduce_sum(tf.gather(emb2, ids)) * 2.0
+                    + tf.reduce_sum(dense * (rank + 1.0)))
+        grads = tape.gradient(loss, [emb1, emb2, dense])
+        assert isinstance(grads[0], tf.IndexedSlices)
+        sopt.apply_gradients(zip(grads, [emb1, emb2, dense]))
+
+    for _ in range(2):
+        sparse_step()
+    np.testing.assert_allclose(
+        dense.numpy(), np.full(4, 1.0 - 2 * 0.1 * total), rtol=1e-5)
 
 
 def scenario_keras_fit():
@@ -232,6 +314,27 @@ def scenario_native_ops():
         tf.TensorSpec(x.shape, x.dtype)).graph
     op_types = {o.type for o in graph.get_operations()}
     assert "HvdAllreduce" in op_types, op_types
+
+    # The optimizer's dense-gradient reduction rides ONE variadic native
+    # kernel (atomic submission; no py_function hop).
+    gvars = [tf.Variable(tf.ones([4]) * (i + 1.0)) for i in range(3)]
+    gopt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.5),
+                                    op=hvd.Sum)
+
+    @tf.function
+    def gstep():
+        with tf.GradientTape() as tape:
+            loss = tf.add_n([tf.reduce_sum(v) for v in gvars]) \
+                * (rank + 1.0)
+        gopt.apply_gradients(zip(tape.gradient(loss, gvars), gvars))
+
+    gstep()
+    gops = {o.type for o in gstep.get_concrete_function().graph
+            .get_operations()}
+    assert "HvdGroupedAllreduce" in gops, gops
+    assert "EagerPyFunc" not in gops, gops
+    np.testing.assert_allclose(
+        gvars[0].numpy(), np.full(4, 1.0 - 0.5 * tot), rtol=1e-6)
 
     # differentiable through the kernel (custom_gradient wraps it)
     v = tf.Variable(np.ones(4, np.float32) * (rank + 1))
