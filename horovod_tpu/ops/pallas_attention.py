@@ -120,7 +120,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[:] + jnp.log(l)         # [bq, 1]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               out_f32=False):
     BH, S, D = q.shape
     bq = _pick_block(S, block_q)
     bk = _pick_block(S, block_k)
@@ -143,7 +144,10 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            # out_f32: emit fp32 partials (ring composition carries them
+            # through the logsumexp combine without per-hop rounding).
+            jax.ShapeDtypeStruct((BH, S, D),
+                                 jnp.float32 if out_f32 else q.dtype),
             jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -167,8 +171,8 @@ def _vmem(shape):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_scr, *, scale, causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+               dq_ref, acc_scr, *, scale, causal, block_q, block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -187,6 +191,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do = do_ref[0]
         lse = lse_ref[0]                           # [bq, 1]
         delta = delta_ref[0]                       # [bq, 1]
+        dlse = dlse_ref[0]                         # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -196,7 +201,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [bq, bk]
-        ds = p * (dp - delta)
+        # d lse_i / d s_ij = p_ij, so an lse cotangent adds p * dlse.
+        ds = p * (dp - delta + dlse)
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -207,7 +213,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
+                dlse_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale, causal, block_q, block_k):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -228,6 +234,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]                           # [bq, 1]
         delta = delta_ref[0]                       # [bq, 1]
+        dlse = dlse_ref[0]                         # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -240,7 +247,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = p * (dp - delta + dlse)
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -253,7 +260,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
     q, k, v, o, lse = res
-    do = g
+    do, dlse = g
     BH, S, D = q.shape
     bq = _pick_block(S, block_q)
     bk = _pick_block(S, block_k)
@@ -262,6 +269,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
     # trailing-singleton blocks.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)         # [BH, S, 1]
+    dlse = dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -274,12 +282,13 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[_vmem((bq, D))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, dlse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
@@ -290,6 +299,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
         ],
@@ -303,7 +313,7 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
         ],
         scratch_shapes=[_vmem((bk, D)), _vmem((bk, D))],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, dlse)
     return dq, dk, dv
 
 
@@ -312,23 +322,45 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret,
+           out_f32):
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                      out_f32)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                   out_f32):
     o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        interpret)
-    return o, (q, k, v, o, lse)
+                        interpret, out_f32)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, interpret, out_f32,
+                   res, g):
     return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _run_flash(q, k, v, causal, scale, block_q, block_k, interpret,
+               out_f32=False):
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
+
+    o, lse = _flash(fold(q), fold(k), fold(v), float(scale),
+                    bool(causal), int(block_q), int(block_k),
+                    bool(interpret), bool(out_f32))
+    o = jnp.moveaxis(o.reshape(B, H, S, D), 1, 2)
+    lse = jnp.moveaxis(lse.reshape(B, H, S), 1, 2)   # [B, S, H]
+    return o, lse
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -341,15 +373,21 @@ def flash_attention(q, k, v, *, causal: bool = True,
     flash backward kernels).  ``interpret`` defaults to True off-TPU so
     the same code tests on the CPU backend.
     """
-    B, S, H, D = q.shape
-    if scale is None:
-        scale = 1.0 / math.sqrt(D)
-    if interpret is None:
-        interpret = _interpret_default()
+    o, _ = _run_flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
 
-    def fold(x):
-        return jnp.moveaxis(x, 2, 1).reshape(B * H, S, D)
 
-    o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
-               int(block_q), int(block_k), bool(interpret))
-    return jnp.moveaxis(o.reshape(B, H, S, D), 1, 2)
+def flash_attention_lse(q, k, v, *, causal: bool = True,
+                        scale: Optional[float] = None,
+                        block_q: int = 512, block_k: int = 512,
+                        interpret: Optional[bool] = None):
+    """Like :func:`flash_attention` but also returns the per-query
+    logsumexp ``[B, S, H]`` (fp32).  The pair ``(o, lse)`` is what
+    blockwise composition needs: partial attentions over disjoint key
+    sets combine exactly via logsumexp weights, which is how
+    ``parallel.ring_attention`` chains this kernel across ``sp`` hops.
+    Both outputs carry gradients (the lse cotangent adds the ``p·dlse``
+    term in the backward kernels).  The partial output is emitted in
+    fp32 (no per-hop rounding when partials are combined)."""
+    return _run_flash(q, k, v, causal, scale, block_q, block_k,
+                      interpret, out_f32=True)

@@ -6,12 +6,13 @@ long-context support as a first-class citizen, and the ICI torus is built
 for it:
 
 * **Ring attention** (`ring_attention`): K/V blocks rotate around the ``sp``
-  ring via ``lax.ppermute`` (one ICI-neighbor hop per step) while each shard
-  accumulates attention for its local queries with an online-softmax
-  (running max / denominator), fp32 accumulators.  Communication is
-  perfectly overlapped by XLA: the next block transfers while the current
-  one is being used — the TPU-native equivalent of what the reference's
-  background thread + streams did for allreduce overlap.
+  ring via ``lax.ppermute`` (one ICI-neighbor hop per step); each hop's
+  local attention runs the Pallas flash kernel
+  (``ops.pallas_attention.flash_attention_lse`` — MXU-tiled, O(block)
+  score memory) and hops compose exactly through logsumexp weights, fp32.
+  Communication is overlapped by XLA: the next block transfers while the
+  current one is being used — the TPU-native equivalent of what the
+  reference's background thread + streams did for allreduce overlap.
 * **Ulysses** (`ulysses_attention`): one ``all_to_all`` turns
   sequence-sharding into head-sharding, full attention runs locally per
   head group, a second ``all_to_all`` restores sequence-sharding.  Cheaper
@@ -36,71 +37,72 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.parallel.shard import shard_map
 
 
-def _online_block(q, k, v, m, l, acc, mask, scale):
-    """One online-softmax accumulation step.
+def _combine_partials(o1, lse1, o2, lse2):
+    """Exactly merge two partial attentions over disjoint key sets.
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; acc like q but
-    fp32.  ``mask``: [Sq, Sk] boolean (True = attend) or None.
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, -1e30)
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    p = jnp.exp(s - m_new[..., None])
-    corr = jnp.exp(m - m_new)
-    l_new = l * corr + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    acc_new = acc * corr.transpose(0, 2, 1)[..., None] + \
-        pv.astype(jnp.float32)
-    return m_new, l_new, acc_new
+    ``o_i`` are normalized partial outputs [B, S, H, D]; ``lse_i`` their
+    per-query logsumexps [B, S, H] (``-inf`` marks an empty/skipped key
+    set).  Standard logsumexp composition, fp32."""
+    m = jnp.maximum(lse1, lse2)
+    # Guard the fully-masked query rows (both -inf): weights become 0/0
+    # otherwise; such rows keep -inf lse and a zero output.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.exp(lse1 - m_safe)
+    w2 = jnp.exp(lse2 - m_safe)
+    tot = w1 + w2
+    norm = jnp.where(tot > 0.0, tot, 1.0)
+    o = (o1.astype(jnp.float32) * (w1 / norm)[..., None]
+         + o2.astype(jnp.float32) * (w2 / norm)[..., None])
+    lse = m + jnp.log(norm)
+    return o, lse
 
 
 def ring_attention(q, k, v, axis: str = "sp", causal: bool = True):
     """Blockwise ring attention over the ``axis`` ring (inside shard_map).
 
     q/k/v: [B, S_local, H, D] — the local sequence shard.  Returns the
-    attention output [B, S_local, H, D] in q's dtype.  Softmax statistics
-    are fp32; the result is exact (not an approximation) — identical to
-    full attention on the gathered sequence, up to fp accumulation order.
+    attention output [B, S_local, H, D] in q's dtype.
+
+    Each hop's local block runs the Pallas flash kernel
+    (``ops.pallas_attention.flash_attention_lse`` — MXU-tiled, O(block)
+    score memory) and hops compose exactly via logsumexp weights
+    (:func:`_combine_partials`); K/V rotate one ICI neighbor per step
+    via ``lax.ppermute``, which XLA overlaps with the current hop's
+    compute.  The result is exact — identical to full attention on the
+    gathered sequence up to fp accumulation order.  This is the
+    ring-flash composition: the kernel's (o, lse) pair is the per-hop
+    partial, the ring is the reduction tree.
     """
+    from horovod_tpu.ops.pallas_attention import flash_attention_lse
+
     n = lax.axis_size(axis)
     my = lax.axis_index(axis)
     B, S, H, D = q.shape
     scale = 1.0 / math.sqrt(D)
     perm = [(i, (i + 1) % n) for i in range(n)]  # send to next neighbor
 
-    tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
-
-    def _mask(owner):
-        if not causal:
-            return None
-        # owner < my: attend fully; owner == my: causal triangle;
-        # owner > my: fully masked.  Select via lax to stay traceable.
-        full = jnp.ones((S, S), jnp.bool_)
-        none = jnp.zeros((S, S), jnp.bool_)
-        return lax.select(
-            owner < my, full, lax.select(owner == my, tri, none))
-
-    # Step 0 is the self-block (no hop); steps 1..n-1 each hop K/V one
-    # neighbor before use, so exactly n-1 ppermutes happen in total.
-    m0 = jnp.full((B, H, S), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((B, H, S), jnp.float32)
-    acc0 = jnp.zeros((B, S, H, D), jnp.float32)
-    m, l, acc = _online_block(q, k, v, m0, l0, acc0, _mask(my), scale)
+    # Step 0 is the self-block (no hop): causal triangle when causal.
+    # Partials are fp32 end-to-end (the kernel emits fp32, the combine
+    # runs fp32), so no per-hop rounding enters the composition.
+    o, lse = flash_attention_lse(q, k, v, causal=causal, scale=scale)
 
     def body(step, carry):
-        k_cur, v_cur, m, l, acc = carry
+        k_cur, v_cur, o, lse = carry
         k_cur = lax.ppermute(k_cur, axis, perm)
         v_cur = lax.ppermute(v_cur, axis, perm)
         # After `step` hops we hold the block of rank (my - step) mod n.
         owner = (my - step) % n
-        m, l, acc = _online_block(q, k_cur, v_cur, m, l, acc,
-                                  _mask(owner), scale)
-        return k_cur, v_cur, m, l, acc
+        o_hop, lse_hop = flash_attention_lse(q, k_cur, v_cur,
+                                             causal=False, scale=scale)
+        if causal:
+            # owner > my holds future tokens: the hop contributes
+            # nothing (lse -inf zeroes its combination weight).
+            lse_hop = jnp.where(owner < my, lse_hop, -jnp.inf)
+        o, lse = _combine_partials(o, lse, o_hop, lse_hop)
+        return k_cur, v_cur, o, lse
 
-    _, _, m, l, acc = lax.fori_loop(1, n, body, (k, v, m, l, acc))
-    out = acc / l.transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    _, _, o, lse = lax.fori_loop(1, n, body, (k, v, o, lse))
+    return o.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis: str = "sp", causal: bool = True):
