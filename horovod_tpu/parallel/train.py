@@ -70,6 +70,8 @@ def make_transformer_train_step(
     cfg: tfm.TransformerConfig,
     mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
+    *,
+    zero1: bool = False,
 ):
     """Returns ``(step_fn, init_fn)``.
 
@@ -77,6 +79,15 @@ def make_transformer_train_step(
     ``step_fn(state, tokens, targets) -> (state, loss)`` is jit-compiled
     over the mesh.  Batch layout: tokens/targets ``[B, S]`` sharded
     ``P('dp', 'sp')``.
+
+    ``zero1=True`` additionally shards the optimizer state over the
+    ``dp`` axis (ZeRO stage 1, GSPMD-style: the moments' shardings get
+    ``dp`` on their first free dimension and XLA turns the gradient
+    sync into reduce-scatter + sharded update + allgather instead of
+    allreduce + replicated update — same math, 1/dp the adam-moment
+    memory per chip).  The reference has no optimizer-state sharding
+    (DP replicates everything); this is TPU-native headroom for large
+    models.
     """
     if optimizer is None:
         optimizer = optax.adamw(1e-3, weight_decay=0.01)
@@ -85,6 +96,31 @@ def make_transformer_train_step(
         lambda s: _sharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
     data_sharding = NamedSharding(mesh, _batch_spec(mesh, "dp", "sp"))
+    zero_axis = "dp" if zero1 and mesh.shape.get("dp", 1) > 1 else None
+    abstract_params = jax.eval_shape(
+        lambda: tfm.init(jax.random.PRNGKey(0), cfg))
+    opt_shardings = _opt_shardings(optimizer, abstract_params,
+                                   param_shardings, zero_axis=zero_axis)
+    if zero1:
+        # The degradation cases must be loud: asking for ZeRO-1 and
+        # getting replicated state is a silent 0x memory saving.
+        from horovod_tpu.utils.logging import get_logger
+
+        if zero_axis is None:
+            get_logger().warning(
+                "zero1=True but the mesh has no dp axis > 1; optimizer "
+                "state stays replicated")
+        else:
+            n_sharded = sum(
+                zero_axis in (s.spec or ())
+                for s in jax.tree.leaves(
+                    opt_shardings,
+                    is_leaf=lambda x: isinstance(x, NamedSharding)))
+            if n_sharded == 0:
+                get_logger().warning(
+                    "zero1=True but no optimizer-state dimension is "
+                    "divisible by dp=%d; state stays replicated",
+                    mesh.shape["dp"])
 
     def init_fn(rng) -> TrainState:
         # Params are born sharded: jit-with-out_shardings means no device
@@ -94,9 +130,7 @@ def make_transformer_train_step(
             lambda k: tfm.init(k, cfg),
             out_shardings=param_shardings)(rng)
         opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=_opt_shardings(optimizer, params,
-                                         param_shardings))(params)
+            optimizer.init, out_shardings=opt_shardings)(params)
         return TrainState(params, opt_state, _step0(mesh))
 
     def _step(state: TrainState, tokens, targets):
@@ -107,20 +141,49 @@ def make_transformer_train_step(
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
-    step_fn = jax.jit(
-        _step,
-        in_shardings=(None, data_sharding, data_sharding),
-        donate_argnums=(0,),
-    )
+    if zero_axis is not None:
+        # Pin the ZeRO placement through the step so the sharded
+        # moments never silently collapse back to replicated (XLA's
+        # propagation would otherwise be free to choose).
+        rep = NamedSharding(mesh, P())
+        state_shardings = TrainState(param_shardings, opt_shardings, rep)
+        step_fn = jax.jit(
+            _step,
+            in_shardings=(state_shardings, data_sharding, data_sharding),
+            out_shardings=(state_shardings, rep),
+            donate_argnums=(0,),
+        )
+    else:
+        step_fn = jax.jit(
+            _step,
+            in_shardings=(None, data_sharding, data_sharding),
+            donate_argnums=(0,),
+        )
     return step_fn, init_fn
 
 
-def _opt_shardings(optimizer, params, param_shardings):
+def _zero1_augment(sharding, shape, axis):
+    """Put ``axis`` on the first free, divisible dimension of a
+    param-mirroring leaf's sharding (ZeRO-1: shard the moments over
+    data-parallel replicas).  Leaves with no eligible dimension keep the
+    param's sharding (replicated over ``axis``)."""
+    mesh = sharding.mesh
+    n = mesh.shape[axis]
+    spec = list(sharding.spec) + [None] * (len(shape) - len(sharding.spec))
+    for i, (ax, dim) in enumerate(zip(spec, shape)):
+        if ax is None and dim % n == 0 and dim >= n:
+            spec[i] = axis
+            return NamedSharding(mesh, P(*spec))
+    return sharding
+
+
+def _opt_shardings(optimizer, params, param_shardings, zero_axis=None):
     """Optimizer-state shardings: state leaves that mirror a param (adam
     moments — their tree path ends with the param's path and the shape
     matches) get that param's sharding; everything else is replicated.
     Path-suffix matching is exact per position, so two params with equal
-    shapes but different specs can't collide."""
+    shapes but different specs can't collide.  ``zero_axis`` additionally
+    shards the param-mirroring leaves over that mesh axis (ZeRO-1)."""
     from jax.tree_util import keystr, tree_flatten_with_path
 
     shapes = jax.eval_shape(optimizer.init, params)
@@ -134,6 +197,8 @@ def _opt_shardings(optimizer, params, param_shardings):
         ps = keystr(path)
         for suf, shape, s in suffixes:
             if ps.endswith(suf) and leaf.shape == shape:
+                if zero_axis is not None:
+                    return _zero1_augment(s, shape, zero_axis)
                 return s
         return NamedSharding(mesh_rep, P())
 
