@@ -1,0 +1,115 @@
+"""Multi-host GSPMD bootstrap: ``jax.distributed`` from the launcher.
+
+The eager engine already spans hosts (TCP mesh via the rendezvous); this
+module gives the COMPILED regime the same reach: under ``hvdrun``, each
+process calls :func:`init_jax_distributed` and its local chips join one
+global ``jax.devices()`` view, so ``Mesh``/``pjit`` programs — and every
+in-graph collective in ``ops.collective`` — span hosts with XLA inserting
+the cross-host transfers (ICI within a slice, DCN across).  Role parity:
+the reference's NCCL/MPI backend is what let one training job span
+hosts; here that job is a GSPMD program and the launcher supplies the
+coordination ``jax.distributed`` needs (coordinator address via the same
+HMAC-signed rendezvous KV the engine bootstraps through).
+
+Usage (inside a program launched by ``hvdrun -np N``)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    hvd.init_jax_distributed()      # local chips join the global mesh
+    # jax.device_count() == chips across ALL hosts from here on
+
+Single-process runs are a no-op, so the same script works under plain
+``python``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+_initialized = False
+
+
+def init_jax_distributed(timeout: float = 120.0) -> None:
+    """Join this process's devices into one global JAX view.
+
+    Must run before the first JAX backend touch in this process (jax
+    requires ``distributed.initialize`` pre-backend-init).  Rank 0
+    binds a free port for the coordination service and publishes it on
+    the launcher's rendezvous KV; other ranks block on that key.
+    Idempotent; no-op for single-process jobs or when the launcher env
+    is absent.
+    """
+    global _initialized
+    if _initialized:
+        return
+    # Rank/size come from the initialized runtime, which already ran the
+    # full discovery chain (HVD_* env, OMPI/PMIx/Slurm/JSM — so mpirun
+    # and srun launches work here too, not just hvdrun's spawn mode).
+    from horovod_tpu import basics
+
+    if basics.is_initialized():
+        rank, size = basics.rank(), basics.size()
+    else:
+        rank = int(os.environ.get("HVD_RANK", "0"))
+        size = int(os.environ.get("HVD_SIZE", "1"))
+    if size <= 1:
+        return
+    rdv_addr = os.environ.get("HVD_RENDEZVOUS_ADDR")
+    rdv_port = os.environ.get("HVD_RENDEZVOUS_PORT")
+    if not rdv_addr or not rdv_port:
+        raise RuntimeError(
+            "init_jax_distributed needs the launcher rendezvous "
+            "(HVD_RENDEZVOUS_ADDR/PORT); run under hvdrun or export "
+            "them manually")
+
+    from horovod_tpu.runner.http_client import KVClient
+
+    kv = KVClient(rdv_addr, int(rdv_port))
+    scope = os.environ.get("HVD_RDV_SCOPE", "")
+    key = f"hvd/{scope}/jax_coordinator" if scope else "hvd/jax_coordinator"
+
+    if rank == 0:
+        coord = f"{_my_addr(kv)}:{_free_port()}"
+        kv.put(key, coord)
+    else:
+        try:
+            coord = kv.wait_get(key, timeout=timeout)
+        except TimeoutError as e:
+            raise RuntimeError(
+                "timed out waiting for the jax.distributed coordinator "
+                "address on the rendezvous KV (did rank 0 call "
+                "init_jax_distributed?)") from e
+
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=size, process_id=rank,
+                               initialization_timeout=int(timeout))
+    _initialized = True
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _my_addr(kv) -> str:
+    """The address peers can reach this host at — same policy as the
+    engine bootstrap (bootstrap.py:58-67): the launcher-probed NIC list
+    wins, else learn the address from the route the rendezvous
+    connection takes."""
+    my_host = None
+    nic = os.environ.get("HVD_NIC")
+    if nic:
+        from horovod_tpu.runner.run import interface_address_any
+
+        try:
+            my_host = interface_address_any(nic)
+        except ValueError:
+            my_host = None  # NIC list from another host; fall back
+    return my_host or kv.local_address() or "127.0.0.1"

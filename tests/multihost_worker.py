@@ -1,0 +1,41 @@
+"""Worker: joins the launcher's gang AND a jax.distributed global mesh."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+hvd.init()
+rank, size = hvd.rank(), hvd.size()
+
+# Must run before the first backend touch.
+hvd.init_jax_distributed()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.process_count() == size, jax.process_count()
+assert jax.device_count() == size, (
+    f"global view should have {size} one-cpu processes, "
+    f"got {jax.device_count()}")
+
+# A real cross-process collective through the global view.
+from jax.experimental import multihost_utils  # noqa: E402
+
+gathered = multihost_utils.process_allgather(
+    np.array([rank + 1.0], np.float32))
+expect = np.arange(1, size + 1, dtype=np.float32)[:, None]
+np.testing.assert_allclose(np.asarray(gathered), expect)
+
+# The eager engine still works alongside (two regimes, one process).
+out = hvd.allreduce(np.ones(4, np.float32), name="mh.check", op=hvd.Sum)
+np.testing.assert_allclose(out, np.full(4, float(size)))
+
+print(f"rank {rank}: jax.distributed global mesh OK", flush=True)
+hvd.shutdown()

@@ -1,0 +1,36 @@
+"""Launcher-driven jax.distributed: the compiled regime spans processes.
+
+The eager engine always spanned hosts (TCP mesh); these tests pin the
+GSPMD twin — ``hvd.init_jax_distributed()`` under ``hvdrun`` joins each
+process's devices into one global ``jax.devices()`` view, with the
+coordinator address published through the same rendezvous KV the engine
+bootstraps from."""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def test_two_process_global_mesh():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # one cpu device per process
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run", "-np", "2",
+         "--", sys.executable, WORKER],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert proc.stdout.count("global mesh OK") == 2, proc.stdout
+
+
+def test_single_process_is_noop():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, WORKER], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, f"{proc.stdout}\n{proc.stderr}"
+    assert "global mesh OK" in proc.stdout
