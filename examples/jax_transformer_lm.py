@@ -52,7 +52,18 @@ def main():
     p.add_argument("--checkpoint-every", type=int, default=25)
     p.add_argument("--fp32", action="store_true",
                    help="compute in fp32 (default bf16 on TPU meshes)")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer state over dp (ZeRO-1)")
+    p.add_argument("--jax-distributed", action="store_true",
+                   help="join all hvdrun processes' devices into one "
+                        "global mesh (hvd.init_jax_distributed)")
     args = p.parse_args()
+
+    if args.jax_distributed:
+        import horovod_tpu as hvd
+
+        hvd.init()
+        hvd.init_jax_distributed()
 
     import jax
     import jax.numpy as jnp
@@ -85,7 +96,8 @@ def main():
         # GSPMD attention otherwise
         attn_impl="ring" if args.sp > 1 else "dense")
 
-    step, init = train_mod.make_transformer_train_step(cfg, mesh)
+    step, init = train_mod.make_transformer_train_step(
+        cfg, mesh, zero1=args.zero1)
 
     def fresh():
         return init(jax.random.PRNGKey(0))
