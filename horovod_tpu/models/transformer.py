@@ -168,13 +168,18 @@ def _rmsnorm(x, g):
     return (y * g).astype(x.dtype)
 
 
-def _rope(x, theta: float):
-    """Rotary embedding over head_dim pairs; x: [B, S, H, HD]."""
+def _rope(x, theta: float, pos=None):
+    """Rotary embedding over head_dim pairs; x: [B, S, H, HD].
+    ``pos``: optional [S] absolute positions (decode steps rotate a
+    single new token at its true position); default ``arange(S)``."""
     B, S, H, HD = x.shape
     half = HD // 2
     freqs = jnp.exp(
         -math.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
-    pos = jnp.arange(S, dtype=jnp.float32)
+    if pos is None:
+        pos = jnp.arange(S, dtype=jnp.float32)
+    else:
+        pos = pos.astype(jnp.float32)
     ang = pos[:, None] * freqs[None, :]          # [S, half]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -348,3 +353,145 @@ def loss_fn(params, tokens, targets, cfg: TransformerConfig,
             *, mesh=None, aux_weight: float = 0.01):
     logits, aux = apply(params, tokens, cfg, mesh=mesh)
     return softmax_xent(logits, targets) + aux_weight * aux
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive generation (KV cache)
+# ---------------------------------------------------------------------------
+#
+# The reference is a training framework with no inference path; a complete
+# model family needs one.  Decode is the classic two-phase shape: one
+# prefill pass caches every layer's rotated K/V for the prompt, then a
+# lax.scan emits one token per step, attending a single query against the
+# cache — O(S) per token instead of O(S^2) recompute.  Dense single-host
+# math (generation batches are small; the parallel axes exist for
+# training).
+
+
+def _attention_cached(x, lp, cfg, k_cache, v_cache, pos):
+    """One token's attention against the cache.
+
+    x: [B, 1, D]; k/v_cache: [B, Smax, H, HD] (valid through ``pos``);
+    ``pos``: scalar index of THIS token.  Returns (out [B, 1, D],
+    updated caches)."""
+    dtype = cfg.compute_dtype
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dtype))
+    p = jnp.full((1,), pos)
+    q = _rope(q, cfg.rope_theta, pos=p)
+    k = _rope(k, cfg.rope_theta, pos=p)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k_cache
+                        ).astype(jnp.float32) * scale
+    Smax = k_cache.shape[1]
+    valid = jnp.arange(Smax) <= pos
+    logits = jnp.where(valid[None, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhst,bthk->bshk", probs, v_cache)
+    return (jnp.einsum("bshk,hkd->bsd", ctx, lp["wo"].astype(dtype)),
+            k_cache, v_cache)
+
+
+def _prefill(params, tokens, cfg, Smax):
+    """Forward over the prompt, returning next-token logits for the last
+    position and per-layer K/V caches [L, B, Smax, H, HD]."""
+    dtype = cfg.compute_dtype
+    B, S = tokens.shape
+    x = params["embed"].astype(dtype)[tokens]
+
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    tri = jnp.tril(jnp.ones((S, S), jnp.bool_))
+
+    def body(h, lp):
+        # Per-layer math of _layer with the projections computed ONCE,
+        # attention inlined densely, and the rotated K/V captured for
+        # the cache (so decode and training can't desynchronize on the
+        # projection/RoPE recipe).
+        y = _rmsnorm(h, lp["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", y, lp["wq"].astype(dtype))
+        k = jnp.einsum("bsd,dhk->bshk", y, lp["wk"].astype(dtype))
+        v = jnp.einsum("bsd,dhk->bshk", y, lp["wv"].astype(dtype))
+        q = _rope(q, cfg.rope_theta)
+        k = _rope(k, cfg.rope_theta)
+        logits = jnp.einsum("bshk,bthk->bhst", q, k
+                            ).astype(jnp.float32) * scale
+        logits = jnp.where(tri[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+        ctx = jnp.einsum("bhst,bthk->bshk", probs, v)
+        h = h + jnp.einsum("bshk,hkd->bsd", ctx, lp["wo"].astype(dtype))
+        h = h + _dense_ffn(_rmsnorm(h, lp["ln2"]), lp, dtype)
+        pad = [(0, 0), (0, Smax - S), (0, 0), (0, 0)]
+        return h, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = vocab_projection(x[:, -1:], params["embed"])[:, 0]
+    return logits, ks, vs
+
+
+def generate(params, prompt, cfg: TransformerConfig, *,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng=None):
+    """Autoregressive decode.  ``prompt``: [B, S0] int32.  Returns
+    [B, S0 + max_new_tokens] (prompt + generated).  ``temperature=0``
+    is greedy argmax; otherwise softmax sampling with ``rng``.
+
+    Dense-FFN configs only (``n_experts=0``) — MoE routing under a
+    one-token capacity is a different decode design.
+    """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "generate() supports dense-FFN configs; MoE decode needs "
+            "per-step routing with capacity 1")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs rng")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    B, S0 = prompt.shape
+    Smax = S0 + max_new_tokens
+    if Smax > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt + new tokens ({Smax}) exceeds max_seq_len "
+            f"({cfg.max_seq_len})")
+    dtype = cfg.compute_dtype
+    logits0, ks, vs = _prefill(params, prompt, cfg, Smax)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature,
+                                          axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, key):
+        tok, pos, ks, vs = carry
+        x = params["embed"].astype(dtype)[tok[:, None]]
+
+        def layer(h, layer_in):
+            lp, k_c, v_c = layer_in
+            y = _rmsnorm(h, lp["ln1"])
+            attn, k_c, v_c = _attention_cached(y, lp, cfg, k_c, v_c, pos)
+            h = h + attn
+            h = h + _dense_ffn(_rmsnorm(h, lp["ln2"]), lp, dtype)
+            return h, (k_c, v_c)
+
+        x, (ks, vs) = lax.scan(layer, x, (params["layers"], ks, vs))
+        x = _rmsnorm(x, params["ln_f"])
+        logits = vocab_projection(x, params["embed"])[:, 0]
+        nxt = sample(logits, key).astype(prompt.dtype)
+        return (nxt, pos + 1, ks, vs), nxt
+
+    keys = jax.random.split(rng, max_new_tokens)
+    first = sample(logits0, keys[0]).astype(prompt.dtype)
+    if max_new_tokens == 1:
+        return jnp.concatenate([prompt, first[:, None]], axis=1)
+    (_, _, _, _), rest = lax.scan(
+        step, (first, jnp.asarray(S0), ks, vs), keys[1:])
+    out = jnp.concatenate(
+        [prompt, first[:, None], rest.swapaxes(0, 1)], axis=1)
+    return out
