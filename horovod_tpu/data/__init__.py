@@ -119,6 +119,67 @@ class ArrayDataset:
         ix = np.asarray(idx)
         return tuple(a[ix] for a in self.arrays)
 
+    @staticmethod
+    def from_parquet(paths, columns: Sequence[str]) -> "ArrayDataset":
+        """Load parquet files (a path, glob, or list) into memory as one
+        dataset — the plain-files twin of the Spark estimators' shard
+        store (``spark/store.py`` writes exactly these).  Each column
+        becomes one array with its stored dtype preserved (Arrow-native
+        conversion, no Python-object hop); list-valued columns reshape
+        to ``[rows, width]`` (one nesting level, rows must agree on
+        width)."""
+        import glob as globlib
+        import os
+
+        import pyarrow.parquet as pq
+
+        if isinstance(paths, (str, bytes, os.PathLike)):
+            pattern = os.fsdecode(paths)
+            matched = sorted(globlib.glob(pattern))
+            if matched:
+                paths = matched
+            elif globlib.has_magic(pattern):
+                raise FileNotFoundError(
+                    f"glob {pattern!r} matched no files")
+            else:
+                paths = [pattern]
+        tables = [pq.read_table(p, columns=list(columns)) for p in paths]
+        cols = []
+        for name in columns:
+            parts = [_arrow_column_to_numpy(t[name]) for t in tables]
+            cols.append(np.concatenate(parts) if len(parts) > 1
+                        else parts[0])
+        return ArrayDataset(*cols)
+
+
+def _arrow_column_to_numpy(chunked) -> np.ndarray:
+    """Arrow column → numpy, dtype-preserving.  Fixed-width list columns
+    reshape from their flattened values buffer (a float32 list column
+    comes back float32 — ``to_pylist`` widened it to float64 and paid an
+    O(n) Python-object conversion)."""
+    import pyarrow as pa
+
+    arrs = []
+    for chunk in chunked.chunks:
+        t = chunk.type
+        if pa.types.is_list(t) or pa.types.is_large_list(t) \
+                or pa.types.is_fixed_size_list(t):
+            values = chunk.flatten().to_numpy(zero_copy_only=False)
+            n = len(chunk)
+            if n == 0:
+                arrs.append(values.reshape(0, -1))
+                continue
+            width, rem = divmod(len(values), n)
+            if rem:
+                raise ValueError(
+                    "ragged list column: rows must agree on width")
+            arrs.append(values.reshape(n, width))
+        else:
+            arrs.append(chunk.to_numpy(zero_copy_only=False))
+    if not arrs:
+        return np.empty((0,))
+    return np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+
 
 def batches(dataset, sampler: ShardedSampler, batch_size: int, *,
             drop_remainder: bool = True) -> Iterator[Tuple[np.ndarray, ...]]:

@@ -154,3 +154,31 @@ def test_end_to_end_sharded_training(jax):
             state, loss = step(state, jnp.asarray(xb), jnp.asarray(yb))
             losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_from_parquet(tmp_path):
+    pq = pytest.importorskip("pyarrow.parquet")
+    import pyarrow as pa
+
+    rs = np.random.RandomState(0)
+    feats = rs.randn(10, 4).astype(np.float32)
+    labels = rs.randint(0, 3, 10).astype(np.int64)
+    # two shards, like spark/store.py writes
+    for i, sl in enumerate((slice(0, 6), slice(6, 10))):
+        pq.write_table(
+            pa.table({"features": list(feats[sl]),
+                      "label": labels[sl]}),
+            tmp_path / f"part-{i:05d}.parquet")
+
+    ds = ArrayDataset.from_parquet(str(tmp_path / "*.parquet"),
+                                   columns=["features", "label"])
+    assert len(ds) == 10
+    x, y = ds.batch([0, 7])
+    # dtypes preserved through the Arrow-native path
+    assert x.dtype == np.float32 and y.dtype == np.int64, (x.dtype,
+                                                           y.dtype)
+    np.testing.assert_allclose(x, feats[[0, 7]], rtol=1e-6)
+    np.testing.assert_array_equal(y, labels[[0, 7]])
+    with pytest.raises(FileNotFoundError, match="matched no files"):
+        ArrayDataset.from_parquet(str(tmp_path / "nope-*.parquet"),
+                                  columns=["label"])
