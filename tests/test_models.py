@@ -33,6 +33,50 @@ def test_resnet_forward_shapes():
     assert np.allclose(same["stem_bn"]["mean"], stats["stem_bn"]["mean"])
 
 
+def test_stem_s2d_matches_7x7_conv():
+    """The space-to-depth stem is an exact rewrite of the 7x7 stride-2
+    conv (same params, rearranged at apply time) — values must agree to
+    fp32 reassociation tolerance, for even and odd spatial sizes (odd
+    falls back to the plain conv) and under grad."""
+    import dataclasses
+
+    cfg = small_resnet_cfg()
+    params, stats = resnet.init(jax.random.PRNGKey(0), cfg)
+    w = params["stem_conv"]
+    for hw in (32, 224):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, hw, hw, 3))
+        ref = resnet._conv(x, w, 2, jnp.float32)
+        out = resnet._stem_s2d_conv(x, w, jnp.float32)
+        assert out.shape == ref.shape, (out.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    # End-to-end: full apply with/without the flag agrees, including the
+    # gradient through the rearranged weights.
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    cfg_plain = dataclasses.replace(cfg, stem_s2d=False)
+    y1, _ = resnet.apply(params, stats, x, cfg, train=True)
+    y2, _ = resnet.apply(params, stats, x, cfg_plain, train=True)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=2e-4, atol=2e-4)
+    # Gradient through the rearranged weights: checked directly on the
+    # stem (through the full net, BN amplifies fp32 reassociation noise
+    # beyond what a tight tolerance can see past).
+    xg = jax.random.normal(jax.random.PRNGKey(4), (2, 32, 32, 3))
+    cot = jax.random.normal(jax.random.PRNGKey(5), (2, 16, 16, 8))
+    g1 = jax.grad(lambda w_: jnp.vdot(
+        resnet._stem_s2d_conv(xg, w_, jnp.float32), cot))(w)
+    g2 = jax.grad(lambda w_: jnp.vdot(
+        resnet._conv(xg, w_, 2, jnp.float32), cot))(w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+    # Odd spatial size: must not crash (falls back to the 7x7 path).
+    xo = jax.random.normal(jax.random.PRNGKey(3), (2, 33, 33, 3))
+    logits, _ = resnet.apply(params, stats, xo, cfg, train=False)
+    assert logits.shape == (2, 10)
+
+
 def test_resnet50_param_count():
     cfg = resnet.resnet50_config()
     shapes = jax.eval_shape(
