@@ -153,6 +153,11 @@ def main() -> None:
     warmup_iters = 3
     iters = 10
     batches_per_iter = 10
+    # Dispatch-amortized chain protocol, shared by the b32 "steady" and
+    # b128 sections — they MUST stay identical or the cross-batch
+    # comparison re-breaks the way the r4 capture did (10- vs 50-step
+    # chains made b128 read below b32).
+    steady_iters, steady_chain = 5, 50
 
     devices = jax.devices()
     on_tpu = devices[0].platform == "tpu"
@@ -203,7 +208,8 @@ def main() -> None:
     if on_tpu:
         try:
             v50, state = _timed_images_per_sec(
-                step, state, images, labels, batch, 5, 50)
+                step, state, images, labels, batch, steady_iters,
+                steady_chain)
             extras["steady_images_per_sec"] = round(v50, 2)
 
             import jax.lax as lax
@@ -261,7 +267,8 @@ def main() -> None:
                 bstate, bloss = bstep(bstate, big_images, big_labels)
             jax.block_until_ready(bloss)
             bval, bstate = _timed_images_per_sec(
-                bstep, bstate, big_images, big_labels, big, 5, 50)
+                bstep, bstate, big_images, big_labels, big, steady_iters,
+                steady_chain)
             extras["batch128_images_per_sec"] = round(bval, 2)
             peak = _peak_flops(devices[0].device_kind)
             if bflops and peak:

@@ -289,7 +289,10 @@ def make_resnet_train_step_hvd(
         optimizer = opt_mod.DistributedOptimizer(
             optax.sgd(0.1, momentum=0.9), axis=axes)
     rep = _replicated(mesh)
-    batch_p = _batch_spec(mesh, *axes)
+    # All data-parallel axes gang up on dim 0 (batch).  P(*axes) would
+    # instead spread them across dims — sharding image height over the
+    # second axis (caught by the hier-ici-dcn dryrun mesh).
+    batch_p = filter_spec(P(axes), mesh) if axes else P()
 
     def init_fn(rng) -> ResNetState:
         params, stats = resnet_model.init(rng, cfg)

@@ -42,14 +42,11 @@ def log(msg: str) -> None:
 
 
 def probe() -> bool:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=PROBE_TIMEOUT, capture_output=True, text=True,
-            cwd=REPO)
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    sys.path.insert(0, REPO)
+    from horovod_tpu.utils.platform import default_backend_alive
+
+    alive, _ = default_backend_alive(timeout=PROBE_TIMEOUT, attempts=1)
+    return alive
 
 
 def capture(out_name: str) -> bool:
@@ -78,12 +75,23 @@ def capture(out_name: str) -> bool:
     with open(out, "w") as f:
         json.dump(line, f, indent=2)
         f.write("\n")
-    subprocess.run(["git", "add", out_name], cwd=REPO)
-    subprocess.run(
+    # Pathspec-limited commit: must not sweep the interactive session's
+    # staged work-in-progress into the auto-commit.
+    rc = subprocess.run(["git", "add", "--", out_name], cwd=REPO,
+                        capture_output=True, text=True)
+    if rc.returncode != 0:
+        log(f"git add FAILED (rc={rc.returncode}): {rc.stderr[-200:]}")
+        return False
+    rc = subprocess.run(
         ["git", "commit", "-m",
          f"Real-chip bench capture: {out_name} "
-         f"({line.get('value')} {line.get('unit')})"],
-        cwd=REPO, capture_output=True)
+         f"({line.get('value')} {line.get('unit')})",
+         "--", out_name],
+        cwd=REPO, capture_output=True, text=True)
+    if rc.returncode != 0:
+        log(f"git commit FAILED (rc={rc.returncode}): {rc.stderr[-200:]}"
+            f" — JSON written to {out_name}, request kept for retry")
+        return False
     log(f"captured + committed {out_name}: {json.dumps(line)[:300]}")
     return True
 
