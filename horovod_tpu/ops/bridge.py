@@ -86,22 +86,51 @@ def _auto_name(kind: str, name: Optional[str]) -> str:
     return eager._auto_name(f"bridge.{kind}", None)
 
 
-def _check_single_device_trace() -> None:
+_MISUSE_MSG = (
+    "engine-bridge collectives cannot run inside shard_map/pmap "
+    "bodies (named mesh axes are in scope — each shard would "
+    "enqueue separately under one tensor name); use the in-graph "
+    "mesh-axis collectives in horovod_tpu.ops.collective instead")
+
+
+def _check_single_device_trace(*operands) -> None:
     """The bridge targets the reference's deployment shape: one process
     per chip, jit on that device.  Inside shard_map/pmap bodies (named
     mesh axes in scope) XLA is the coordinator — ordered host callbacks
     there would submit one enqueue per *shard* under the same tensor
-    name; refuse with a pointer to the mesh-axis collectives."""
-    try:
-        import jax.core
+    name; refuse with a pointer to the mesh-axis collectives.
 
-        nonempty = jax.core.nonempty_axis_env_DO_NOT_USE()
-    except ImportError:
+    Two detection layers so the failure mode is a ``TypeError`` at trace
+    time rather than a hang (tests/test_eager_single.py pins the raise
+    on the shipped jax version):
+
+    1. the axis-env probe (``nonempty_axis_env_DO_NOT_USE``, jax<=0.9);
+    2. if a jax upgrade removes that API: the *operands* themselves —
+       inside shard_map/pmap the arguments are tracers whose trace type
+       lives in the shard_map/pmap interpreter module, which survives
+       private-API churn far better than any probe function.
+    """
+    import jax.core
+
+    probe = getattr(jax.core, "nonempty_axis_env_DO_NOT_USE", None)
+    if probe is not None:
+        if probe():
+            raise TypeError(_MISUSE_MSG)
         return
-    except AttributeError:
-        # The probe API was removed by a jax upgrade: the guard cannot
-        # run, and a shard_map misuse would hang instead of raising.
-        # Warn (once, via the default dedup) rather than fail silently.
+    # Probe API gone: fall back to operand-trace inspection.  A concrete
+    # (non-tracer) operand positively proves there is no surrounding
+    # trace, and a plain-jit tracer is equally conclusive — only the
+    # zero-operand path (barrier) leaves the guard blind.
+    for x in operands:
+        if isinstance(x, jax.core.Tracer):
+            tr = type(getattr(x, "_trace", None))
+            label = f"{tr.__module__}.{tr.__name__}".lower()
+            if "shard_map" in label or "pmap" in label:
+                raise TypeError(_MISUSE_MSG)
+    if not operands:
+        # Nothing to inspect: the guard is blind on this jax version —
+        # warn once rather than fail silently, because the misuse
+        # symptom is a hang.
         import warnings
 
         warnings.warn(
@@ -109,13 +138,6 @@ def _check_single_device_trace() -> None:
             "jax version; engine-bridge collectives called inside "
             "shard_map bodies will misbehave instead of raising. Use "
             "ops.collective there.", RuntimeWarning, stacklevel=3)
-        return
-    if nonempty:
-        raise TypeError(
-            "engine-bridge collectives cannot run inside shard_map/pmap "
-            "bodies (named mesh axes are in scope — each shard would "
-            "enqueue separately under one tensor name); use the in-graph "
-            "mesh-axis collectives in horovod_tpu.ops.collective instead")
 
 
 def _io_callback(fn, result_spec, *args):
@@ -259,7 +281,7 @@ def allreduce(x, name: Optional[str] = None,
     """
     from horovod_tpu.ops.compression import Compression
 
-    _check_single_device_trace()
+    _check_single_device_trace(x)
     _ensure_vjps()
     name = _auto_name("allreduce", name)
     compression = compression or Compression.none
@@ -355,7 +377,7 @@ def grouped_allreduce(tensors, name: Optional[str] = None,
 
     from horovod_tpu.ops.compression import Compression
 
-    _check_single_device_trace()
+    _check_single_device_trace(*jax.tree.leaves(tensors))
     _ensure_vjps()
     base = _auto_name("grouped_allreduce", name)
     compression = compression or Compression.none
@@ -417,7 +439,7 @@ def allgather(x, name: Optional[str] = None, process_set=None):
     Static shapes require every rank to contribute the same shape (the
     ragged-first-dim negotiation is eager-only; in-graph XLA has the same
     restriction, ops/collective.py:153)."""
-    _check_single_device_trace()
+    _check_single_device_trace(x)
     _ensure_vjps()
     name = _auto_name("allgather", name)
     return _allgather_vjp(x, name, process_set)
@@ -480,7 +502,7 @@ def broadcast(x, root_rank: int = 0, name: Optional[str] = None,
               process_set=None):
     """Negotiated broadcast inside ``jit``.  Gradient: sum-allreduce on
     the root, zero elsewhere (reference ``_broadcast_grad``)."""
-    _check_single_device_trace()
+    _check_single_device_trace(x)
     _ensure_vjps()
     name = _auto_name("broadcast", name)
     return _broadcast_vjp(x, name, root_rank, process_set)
@@ -536,7 +558,7 @@ def reducescatter(x, name: Optional[str] = None,
 
     from horovod_tpu.ops.cpu_backend import _chunk_bounds
 
-    _check_single_device_trace()
+    _check_single_device_trace(x)
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.MIN,
                   ReduceOp.MAX, ReduceOp.PRODUCT):
         raise ValueError(f"reducescatter does not support op {op}")
@@ -570,7 +592,7 @@ def alltoall(x, name: Optional[str] = None, process_set=None):
     restriction as the in-graph op, ops/collective.py:232)."""
     import jax
 
-    _check_single_device_trace()
+    _check_single_device_trace(x)
     name = _auto_name("alltoall", name)
     n = _group_size(process_set)
     if x.shape[0] % n:

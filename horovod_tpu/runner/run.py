@@ -301,12 +301,28 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         # Workers advertise on the named NIC too (bootstrap_mesh reads
         # HVD_NIC), not just the launcher's rendezvous bind.
         env_extra["HVD_NIC"] = args.nics
+    multi_host = any(not _is_local(s.hostname) for s in slots)
+    if multi_host and args.launcher == "spawn":
+        # Fail fast on unreachable hosts BEFORE starting the rendezvous
+        # server or spawning anything (parity: run/run.py:597-622), with
+        # repeat launches skipping the probe inside the on-disk cache
+        # window (run/util/cache.py).
+        from horovod_tpu.runner import ssh_check
+
+        fn_cache = None
+        if not args.disable_cache:
+            fn_cache = ssh_check.LaunchCache(ssh_check.params_hash(
+                args.np, args.hosts or args.hostfile, args.ssh_port))
+        remote = sorted({s.hostname for s in slots
+                         if not _is_local(s.hostname)})
+        ssh_check.check_hosts_ssh(
+            remote, ssh_port=args.ssh_port,
+            ssh_identity_file=args.ssh_identity_file, cache=fn_cache)
     server = RendezvousServer(host=nic_addr or "0.0.0.0",
                               secret=job_secret)
     port = server.start()
     # Workers reach the rendezvous at this host; for multi-host jobs they
     # need a routable address, not loopback.
-    multi_host = any(not _is_local(s.hostname) for s in slots)
     addr = nic_addr or (_routable_address() if multi_host
                         else "127.0.0.1")
     if multi_host and not nic_addr:

@@ -39,6 +39,40 @@ class TestGaussianProcess:
         _, s_far = gp.predict(np.array([[0.0]]))
         assert s_far[0] > s_near[0]
 
+    def test_length_scale_fit_adapts_to_surface(self):
+        """Marginal-likelihood selection (parity:
+        gaussian_process.cc:44+ L-BFGS MLE) must pick a short
+        length-scale for a wiggly surface and a long one for a smooth
+        trend — the property the old fixed ℓ=0.25 could not have."""
+        x = np.linspace(0, 1, 14)[:, None]
+        gp_wiggly = GaussianProcess()
+        gp_wiggly.fit(x, np.sin(6 * np.pi * x.ravel()))
+        gp_smooth = GaussianProcess()
+        gp_smooth.fit(x, 0.3 + 0.2 * x.ravel())
+        assert gp_wiggly.length_scale < 0.25
+        assert gp_smooth.length_scale > gp_wiggly.length_scale * 2
+
+    def test_length_scale_fit_beats_bad_fixed(self):
+        """Held-out prediction on a fast-varying surface: the fitted
+        length-scale must beat a badly over-smoothed fixed one."""
+        x = np.linspace(0, 1, 17)[:, None]
+        y = np.sin(2 * np.pi * x.ravel())
+        tr = np.arange(len(x)) % 2 == 0
+        xq, yq = x[~tr], y[~tr]
+        fit_gp = GaussianProcess()
+        fit_gp.fit(x[tr], y[tr])
+        bad_gp = GaussianProcess(length_scale=1.0)
+        bad_gp.fit(x[tr], y[tr])
+        fit_err = np.abs(fit_gp.predict(xq)[0] - yq).mean()
+        bad_err = np.abs(bad_gp.predict(xq)[0] - yq).mean()
+        assert fit_err < bad_err * 0.5, (fit_err, bad_err)
+
+    def test_fixed_length_scale_is_not_refit(self):
+        gp = GaussianProcess(length_scale=0.25)
+        x = np.linspace(0, 1, 12)[:, None]
+        gp.fit(x, np.sin(6 * np.pi * x.ravel()))
+        assert gp.length_scale == 0.25
+
 
 class TestBayesianOptimization:
     def test_finds_max_of_quadratic(self):
@@ -103,6 +137,36 @@ class TestParameterManager:
         assert pm is not None
         assert "fusion" not in pm._dims
         assert "cycle" in pm._dims
+
+
+def test_autotune_settles_unfused_on_large_tensor_surface():
+    """Second convergence shape: the regime where fusion LOSES.  The
+    measured surface (docs/benchmarks.md, native engine, 64 MB single
+    tensors: fused 48 MB/s vs unfused 159.8 MB/s — the fusion-buffer
+    copy is a pure extra memory pass once messages are already large)
+    replayed through the ParameterManager's real scoring loop: each
+    sample window accrues bytes at the measured rate for the *current*
+    threshold.  Live re-measurement of this regime is minutes of 64 MB
+    rings and, re-probed on today's box load, the margin at CI-sized
+    tensors is inside run-to-run noise — so the test pins the tuner's
+    behavior on the measured shape, while
+    test_autotune_converges_to_measured_optimum keeps the live loop on
+    the fusion-wins shape."""
+    tensor_mb = 8
+    pm = ParameterManager(
+        TunedParams(64 << 20, 0.005, True),
+        tune_cycle=False, tune_cache=False,
+        warmup_samples=1, max_samples=14, sample_duration_s=0.01)
+    rng = np.random.RandomState(0)
+    t = 0.0
+    while not pm.done:
+        t += 0.02
+        fused = pm.current.fusion_threshold >= (tensor_mb << 20)
+        rate_mb_s = (48.0 if fused else 159.8) * (1 + 0.05 * rng.randn())
+        pm.record_bytes(int(rate_mb_s * (1 << 20) * 0.02), now=t)
+        assert t < 50.0, "tuner never finished"
+    assert pm.current.fusion_threshold < (tensor_mb << 20), \
+        pm.current.fusion_threshold
 
 
 @pytest.mark.parametrize("engine", ENGINES)

@@ -102,3 +102,39 @@ def test_compression_fp16_eager():
     out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16)
     assert out.dtype == np.float32
     np.testing.assert_allclose(out, x, rtol=1e-2)
+
+
+def test_bridge_misuse_inside_shard_map_raises(monkeypatch):
+    """A bridge collective traced inside shard_map must raise TypeError at
+    trace time (the un-guarded failure mode is a hang: one enqueue per
+    shard under a single tensor name).  Pinned on the shipped jax via the
+    axis-env probe, and again with the probe hidden so the operand-tracer
+    fallback layer is exercised (the layer that survives jax removing the
+    private probe API)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.ops import bridge
+
+    devs = np.array(jax.devices()[:2])
+    if devs.size < 2:
+        pytest.skip("needs >=2 virtual devices")
+    mesh = Mesh(devs, ("dp",))
+
+    def body(x):
+        return bridge.allreduce(x, name="misuse")
+
+    from jax import shard_map
+
+    f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    with pytest.raises(TypeError, match="shard_map"):
+        f(jnp.ones((4,), jnp.float32))
+
+    # Layer 2: probe API gone -> operand-tracer detection must still raise.
+    import jax.core as jcore
+
+    monkeypatch.delattr(jcore, "nonempty_axis_env_DO_NOT_USE",
+                        raising=False)
+    with pytest.raises(TypeError, match="shard_map"):
+        f(jnp.ones((4,), jnp.float32))

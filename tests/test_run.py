@@ -528,3 +528,97 @@ def test_jsm_env_discovery(monkeypatch):
     t = discovery.from_mpi_env()
     assert (t.rank, t.size, t.local_rank, t.local_size,
             t.cross_rank) == (2, 4, 0, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# ssh pre-checks + on-disk launch cache (parity: run/run.py:597-622,
+# run/util/cache.py:130)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fake_ssh(tmp_path, monkeypatch):
+    """Put a fake `ssh` first on PATH: succeeds for hosts starting with
+    'good', fails otherwise; logs every probed host to a file."""
+    log = tmp_path / "ssh_calls.log"
+    shim = tmp_path / "bin" / "ssh"
+    shim.parent.mkdir()
+    shim.write_text(
+        "#!/bin/sh\n"
+        "host=''\n"
+        "prev=''\n"
+        "for a in \"$@\"; do\n"
+        "  case \"$a\" in -*) ;; true) host=$prev ;; *) prev=$a ;; esac\n"
+        "done\n"
+        f"echo \"$host\" >> {log}\n"
+        "case \"$host\" in good*) exit 0 ;; *) echo unreachable >&2; exit 255 ;; esac\n")
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{shim.parent}:{os.environ['PATH']}")
+    monkeypatch.setenv("HVD_CACHE_DIR", str(tmp_path / "cache"))
+    return log
+
+
+def _calls(log):
+    return log.read_text().split() if log.exists() else []
+
+
+def test_ssh_check_unreachable_host_fails_named(fake_ssh):
+    from horovod_tpu.runner import ssh_check
+
+    with pytest.raises(ssh_check.SSHUnreachableError) as ei:
+        ssh_check.check_hosts_ssh(["goodhost1", "badhost1"], timeout=20)
+    assert "badhost1" in str(ei.value)
+    assert "goodhost1" not in str(ei.value)
+
+
+def test_ssh_check_cache_skips_within_window(fake_ssh):
+    from horovod_tpu.runner import ssh_check
+
+    cache = ssh_check.LaunchCache("t1")
+    ssh_check.check_hosts_ssh(["goodhost1", "goodhost2"], cache=cache,
+                              timeout=20)
+    assert sorted(_calls(fake_ssh)) == ["goodhost1", "goodhost2"]
+    # Second launch, same params: no new probes.
+    ssh_check.check_hosts_ssh(["goodhost1", "goodhost2"], cache=cache,
+                              timeout=20)
+    assert sorted(_calls(fake_ssh)) == ["goodhost1", "goodhost2"]
+    # No cache (--disable-cache): probes again.
+    ssh_check.check_hosts_ssh(["goodhost1"], cache=None, timeout=20)
+    assert sorted(_calls(fake_ssh)) == ["goodhost1", "goodhost1",
+                                        "goodhost2"]
+
+
+def test_ssh_check_stale_cache_reprobes(fake_ssh):
+    from horovod_tpu.runner import ssh_check
+
+    cache = ssh_check.LaunchCache("t2", staleness_minutes=0.0)
+    ssh_check.check_hosts_ssh(["goodhost1"], cache=cache, timeout=20)
+    ssh_check.check_hosts_ssh(["goodhost1"], cache=cache, timeout=20)
+    assert _calls(fake_ssh) == ["goodhost1", "goodhost1"]
+
+
+def test_ssh_check_failure_not_cached(fake_ssh):
+    from horovod_tpu.runner import ssh_check
+
+    cache = ssh_check.LaunchCache("t3")
+    with pytest.raises(ssh_check.SSHUnreachableError):
+        ssh_check.check_hosts_ssh(["badhost1"], cache=cache, timeout=20)
+    with pytest.raises(ssh_check.SSHUnreachableError):
+        ssh_check.check_hosts_ssh(["badhost1"], cache=cache, timeout=20)
+    assert _calls(fake_ssh) == ["badhost1", "badhost1"]
+
+
+def test_launcher_fails_fast_before_spawn(fake_ssh):
+    """hvdrun with an unreachable remote host must die on the named ssh
+    error without spawning any worker (the command would create a
+    sentinel file if any rank ran)."""
+    from horovod_tpu.runner import run as run_mod
+    from horovod_tpu.runner.ssh_check import SSHUnreachableError
+
+    sentinel = str(fake_ssh) + ".spawned"
+    with pytest.raises(SSHUnreachableError) as ei:
+        run_mod.run_commandline(
+            ["-np", "2", "-H", "badhost9:2", "--start-timeout", "5",
+             "--", "touch", sentinel])
+    assert "badhost9" in str(ei.value)
+    assert not os.path.exists(sentinel)
