@@ -61,8 +61,11 @@ def materialize(df, store: Store, run_id: str, num_shards: int) -> int:
     bounds = np.linspace(0, n, num_shards + 1).astype(int)
     for i in range(num_shards):
         shard = pdf.iloc[bounds[i]:bounds[i + 1]]
-        pq.write_table(pa.Table.from_pandas(shard),
-                       os.path.join(path, f"part-{i:05d}.parquet"))
+        # Through the store's own open() so remote (fsspec) stores get
+        # the shards too, not just local paths.
+        with store.open(store.join(path, f"part-{i:05d}.parquet"),
+                        "wb") as f:
+            pq.write_table(pa.Table.from_pandas(shard), f)
     return n
 
 
@@ -93,7 +96,10 @@ def read_shard(store: Store, run_id: str, rank: int, size: int,
             f"materialized but the job has {size} ranks; set the "
             f"estimator's num_proc to the actual world size")
 
-    frames = [pq.read_table(p).to_pandas() for p in mine]
+    frames = []
+    for p in mine:
+        with store.open(p, "rb") as f:
+            frames.append(pq.read_table(f).to_pandas())
     import pandas as pd
 
     pdf = pd.concat(frames) if len(frames) > 1 else frames[0]
@@ -155,7 +161,13 @@ class HorovodEstimator:
 
     def __init__(self, *, feature_cols=("features",), label_cols=("label",),
                  batch_size=32, epochs=1, num_proc=2, store=None,
-                 backend=None, run_id=None, verbose=1, seed=1234):
+                 backend=None, run_id=None, verbose=1, seed=1234,
+                 resume=True):
+        """``resume=True`` (default, matching the reference's
+        torch/remote.py contract): a fit whose ``run_id`` already has
+        epoch checkpoints in the store continues from the newest one.
+        ``resume=False`` deletes the run's directory first so the fit
+        is clean even under a reused ``run_id``."""
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
         self.batch_size = batch_size
@@ -167,10 +179,13 @@ class HorovodEstimator:
         self.run_id = run_id
         self.verbose = verbose
         self.seed = seed
+        self.resume = resume
 
     def _fit(self, df, train_fn_builder) -> Dict[str, Any]:
         run_id = self.run_id or f"run-{uuid.uuid4().hex[:8]}"
         self._last_run_id = run_id
+        if not self.resume:
+            self.store.delete(self.store.run_path(run_id))
         materialize(df, self.store, run_id, self.num_proc)
         backend = self.backend or default_backend(self.num_proc)
         results = backend.run(train_fn_builder(run_id))
@@ -245,11 +260,43 @@ class TorchEstimator(HorovodEstimator):
                 dist_opt = hvd.DistributedOptimizer(
                     opt_builder(local.parameters()),
                     named_parameters=local.named_parameters())
-                hvd.broadcast_parameters(local.state_dict(), root_rank=0)
-                rs = np.random.RandomState(seed + rank)
+                # Resume: the newest epoch checkpoint in the run's store
+                # directory restores model + optimizer + history, and
+                # training continues from the following epoch (parity:
+                # torch/remote.py loads the store checkpoint before the
+                # epoch loop).  Rank 0 reads; broadcast aligns everyone.
+                import io as _io
+
+                start_epoch = 0
                 history = []
-                for _epoch in range(epochs):
-                    perm = rs.permutation(len(X))
+                ck = store.latest_checkpoint(run_id) if rank == 0 else None
+                flag = hvd.broadcast_object(
+                    ck[0] if ck else None, root_rank=0,
+                    name="est.resume.epoch")
+                if flag is not None:
+                    if rank == 0:
+                        st = torch.load(_io.BytesIO(ck[1]),
+                                        map_location="cpu",
+                                        weights_only=False)
+                        local.load_state_dict(st["model"])
+                        dist_opt.load_state_dict(st["optimizer"])
+                        history = list(st.get("history", []))
+                    start_epoch = int(flag) + 1
+                    history = hvd.broadcast_object(
+                        history, root_rank=0, name="est.resume.hist")
+                # Optimizer state FIRST: on a fresh optimizer its
+                # broadcast initializes state via a root-only zero-grad
+                # step, which can move root's params (e.g. AdamW's
+                # decoupled decay) — the parameter broadcast after it
+                # re-syncs every replica.
+                hvd.broadcast_optimizer_state(dist_opt, root_rank=0)
+                hvd.broadcast_parameters(local.state_dict(), root_rank=0)
+                for _epoch in range(start_epoch, epochs):
+                    # Permutation keyed by (seed, rank, epoch) so a
+                    # resumed epoch E shuffles exactly like epoch E of
+                    # an uninterrupted run.
+                    perm = np.random.RandomState(
+                        [seed, rank, _epoch]).permutation(len(X))
                     total, nb = 0.0, 0
                     for i in range(0, len(X), batch_size):
                         idx = perm[i:i + batch_size]
@@ -268,10 +315,18 @@ class TorchEstimator(HorovodEstimator):
                         torch.tensor([total / max(nb, 1)]),
                         op=hvd.Average, name=f"est.loss.{_epoch}")[0])
                     history.append(avg)
+                    if rank == 0:
+                        buf = _io.BytesIO()
+                        torch.save({"model": local.state_dict(),
+                                    "optimizer": dist_opt.state_dict(),
+                                    "history": history}, buf)
+                        store.save_checkpoint(run_id, _epoch,
+                                              buf.getvalue())
                 if rank == 0:
-                    store.makedirs(store.run_path(run_id))
-                    torch.save(local.state_dict(),
-                               store.checkpoint_path(run_id) + ".pt")
+                    buf = _io.BytesIO()
+                    torch.save(local.state_dict(), buf)
+                    store.write_bytes(store.checkpoint_path(run_id)
+                                      + ".pt", buf.getvalue())
                     return {"state_dict": {
                         k: v.detach().cpu().numpy()
                         for k, v in local.state_dict().items()},
@@ -362,6 +417,9 @@ class KerasEstimator(HorovodEstimator):
                 import horovod_tpu.keras as hvd_keras
                 import horovod_tpu.tensorflow as hvd
 
+                import io as _io
+                import pickle
+
                 rank, size = hvd.rank(), hvd.size()
                 X, y = read_shard(store, run_id, rank, size,
                                   feature_cols, label_cols)
@@ -371,19 +429,85 @@ class KerasEstimator(HorovodEstimator):
                     keras.optimizers.deserialize(copy.deepcopy(opt_cfg)))
                 local.compile(optimizer=opt, loss=loss, metrics=metrics,
                               run_eagerly=True)
-                hist = local.fit(
-                    X, y, batch_size=batch_size, epochs=epochs, verbose=0,
-                    callbacks=[
-                        hvd_keras.callbacks
-                        .BroadcastGlobalVariablesCallback(0),
-                        hvd_keras.callbacks.MetricAverageCallback(),
-                    ])
+                # Resume from the newest epoch checkpoint in the store
+                # (weights + history; parity: keras/estimator.py resumes
+                # from store checkpoints between fit() invocations).
+                start_epoch = 0
+                prev_hist: Dict[str, List[float]] = {}
+                ck = store.latest_checkpoint(run_id) if rank == 0 else None
+                resume = hvd.broadcast_object(
+                    None if ck is None else
+                    {"epoch": ck[0],
+                     **pickle.loads(ck[1])}, root_rank=0,
+                    name="est.keras.resume")
+                if resume is not None:
+                    local.set_weights(resume["weights"])
+                    prev_hist = resume.get("history", {})
+                    start_epoch = resume["epoch"] + 1
+                    # Restore optimizer slots + iteration counter so the
+                    # resumed dynamics (Adam moments, LR schedules)
+                    # continue instead of restarting (the torch path
+                    # restores dist_opt.state_dict() the same way).
+                    if resume.get("opt_vars") is not None:
+                        local.optimizer.build(local.trainable_variables)
+                        for var, val in zip(local.optimizer.variables,
+                                            resume["opt_vars"]):
+                            var.assign(val)
+
+                class _EpochCheckpoint(keras.callbacks.Callback):
+                    """Rank 0 writes weights+history to the store after
+                    every epoch (reference: ckpt_callback in
+                    keras/estimator.py writing to get_checkpoint_path)."""
+
+                    def __init__(self, running_hist):
+                        super().__init__()
+                        self._hist = running_hist
+
+                    def on_epoch_end(self, epoch, logs=None):
+                        for k, v in (logs or {}).items():
+                            self._hist.setdefault(k, []).append(float(v))
+                        if rank == 0:
+                            store.save_checkpoint(
+                                run_id, start_epoch + epoch,
+                                pickle.dumps(
+                                    {"weights":
+                                     self.model.get_weights(),
+                                     "opt_vars":
+                                     [np.asarray(v) for v in
+                                      self.model.optimizer.variables],
+                                     "history": self._hist}))
+
+                running_hist = {k: list(v) for k, v in prev_hist.items()}
+                if start_epoch < epochs:
+                    local.fit(
+                        X, y, batch_size=batch_size,
+                        epochs=epochs - start_epoch, verbose=0,
+                        callbacks=[
+                            hvd_keras.callbacks
+                            .BroadcastGlobalVariablesCallback(0),
+                            hvd_keras.callbacks.MetricAverageCallback(),
+                            _EpochCheckpoint(running_hist),
+                        ])
                 if rank == 0:
                     store.makedirs(store.run_path(run_id))
-                    local.save(store.checkpoint_path(run_id) + ".keras")
+                    # .keras archives need a real file; serialize via a
+                    # temp file, then place the bytes through the store
+                    # so remote backends get the artifact too.
+                    import tempfile
+
+                    with tempfile.NamedTemporaryFile(
+                            suffix=".keras", delete=False) as tf:
+                        tmp_name = tf.name
+                    try:
+                        local.save(tmp_name)
+                        with open(tmp_name, "rb") as f:
+                            store.write_bytes(
+                                store.checkpoint_path(run_id) + ".keras",
+                                f.read())
+                    finally:
+                        os.unlink(tmp_name)
                     return {"weights": local.get_weights(),
-                            "history": {k: [float(x) for x in v]
-                                        for k, v in hist.history.items()}}
+                            "history": running_hist}
                 return None
 
             return _train

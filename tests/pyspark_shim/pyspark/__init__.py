@@ -1,0 +1,165 @@
+"""Barrier-scheduler conformance shim for ``horovod_tpu.spark.run``.
+
+TEST INFRASTRUCTURE, not a Spark reimplementation.  pyspark cannot be
+installed in this environment (zero egress — see
+``docs/spark_descope.md`` for the committed install-failure evidence),
+so this package provides the exact pyspark API surface that
+``horovod_tpu.spark.run`` touches, with the one property that matters
+faithfully reproduced: **each barrier task runs in its own separate
+Python process**, concurrently (gang-scheduled), like Spark barrier
+execution mode.  Everything under test — ``run()`` itself, its env
+contract, the driver's RendezvousServer, ``hvd.init()``, the eager
+engine gang, shutdown, env restoration — is the real framework code
+executing distributed; only the task *scheduler* is this shim.
+
+Surface implemented (matching pyspark 3.x):
+  ``pyspark.BarrierTaskContext.get()`` → ``partitionId`` /
+  ``getTaskInfos`` (objects with ``.address``) /
+  ``stageAttemptNumber`` / ``barrier``;
+  ``pyspark.sql.SparkSession.builder.getOrCreate()`` →
+  ``.sparkContext`` with ``defaultParallelism``, ``getConf().get``,
+  ``parallelize(...).barrier().mapPartitions(fn).collect()``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import List
+
+
+class _TaskInfo:
+    def __init__(self, address: str):
+        self.address = address
+
+
+class BarrierTaskContext:
+    """Worker-side context; ``_worker`` installs the singleton."""
+
+    _current = None
+
+    def __init__(self, rank: int, addresses: List[str], attempt: int = 0):
+        self._rank = rank
+        self._addresses = addresses
+        self._attempt = attempt
+
+    @classmethod
+    def get(cls) -> "BarrierTaskContext":
+        if cls._current is None:
+            raise RuntimeError(
+                "BarrierTaskContext.get() outside a barrier task")
+        return cls._current
+
+    def partitionId(self) -> int:
+        return self._rank
+
+    def getTaskInfos(self) -> List[_TaskInfo]:
+        return [_TaskInfo(a) for a in self._addresses]
+
+    def stageAttemptNumber(self) -> int:
+        return self._attempt
+
+    def barrier(self) -> None:
+        # File-based global barrier across the gang's processes.
+        bdir = os.environ.get("PYSPARK_SHIM_BARRIER_DIR")
+        if not bdir:
+            return
+        import time
+
+        me = os.path.join(bdir, f"rank{self._rank}")
+        open(me, "w").close()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(os.listdir(bdir)) >= len(self._addresses):
+                return
+            time.sleep(0.01)
+        raise TimeoutError("shim barrier timed out")
+
+
+class _Barrier:
+    def __init__(self, sc, n: int):
+        self._sc = sc
+        self._n = n
+
+    def mapPartitions(self, fn):
+        return _Mapped(self._sc, self._n, fn)
+
+
+class _RDD:
+    def __init__(self, sc, n: int):
+        self._sc = sc
+        self._n = n
+
+    def barrier(self) -> _Barrier:
+        return _Barrier(self._sc, self._n)
+
+
+class _Mapped:
+    def __init__(self, sc, n: int, fn):
+        self._sc = sc
+        self._n = n
+        self._fn = fn
+
+    def collect(self):
+        """Spawn one real subprocess per barrier task, concurrently, and
+        gather every yielded item (the gang-scheduling contract of
+        barrier mode: all tasks run at once or none do)."""
+        import cloudpickle
+
+        n = self._n
+        addresses = [f"127.0.0.1:{40000 + r}" for r in range(n)]
+        with tempfile.TemporaryDirectory(prefix="pyspark_shim_") as td:
+            payload = os.path.join(td, "task.pkl")
+            with open(payload, "wb") as f:
+                pickle.dump({"fn": cloudpickle.dumps(self._fn),
+                             "addresses": addresses,
+                             "attempt": 0}, f)
+            env = dict(os.environ)
+            shim_dir = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            env["PYTHONPATH"] = os.pathsep.join(
+                [shim_dir] + env.get("PYTHONPATH", "").split(os.pathsep))
+            env["PYSPARK_SHIM_BARRIER_DIR"] = os.path.join(td, "barrier")
+            os.makedirs(env["PYSPARK_SHIM_BARRIER_DIR"], exist_ok=True)
+            procs = []
+            for r in range(n):
+                out = os.path.join(td, f"out{r}.pkl")
+                procs.append((r, out, subprocess.Popen(
+                    [sys.executable, "-m", "pyspark._worker",
+                     payload, str(r), out],
+                    env=env)))
+            results = []
+            failed = []
+            for r, out, p in procs:
+                rc = p.wait()
+                if rc != 0 or not os.path.exists(out):
+                    failed.append((r, rc))
+                    continue
+                with open(out, "rb") as f:
+                    results.extend(pickle.load(f))
+            if failed:
+                raise RuntimeError(
+                    f"barrier tasks failed: {failed} (stderr went to "
+                    "the test's captured output)")
+            return results
+
+
+class _Conf:
+    def get(self, key: str, default=None):
+        if key == "spark.driver.host":
+            return "127.0.0.1"
+        return default
+
+
+class SparkContext:
+    def __init__(self, parallelism: int):
+        self.defaultParallelism = parallelism
+
+    def getConf(self) -> _Conf:
+        return _Conf()
+
+    def parallelize(self, data, numSlices: int) -> _RDD:
+        return _RDD(self, numSlices)

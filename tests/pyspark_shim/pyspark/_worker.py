@@ -1,0 +1,27 @@
+"""Barrier-task worker entry: rebuild the task closure in a fresh
+process, install the BarrierTaskContext singleton, run the task, pickle
+whatever it yields."""
+
+import pickle
+import sys
+
+
+def main() -> None:
+    payload_path, rank, out_path = (
+        sys.argv[1], int(sys.argv[2]), sys.argv[3])
+    with open(payload_path, "rb") as f:
+        payload = pickle.load(f)
+    import cloudpickle
+
+    fn = cloudpickle.loads(payload["fn"])
+    from pyspark import BarrierTaskContext
+
+    BarrierTaskContext._current = BarrierTaskContext(
+        rank, payload["addresses"], payload["attempt"])
+    out = list(fn(iter([rank])))
+    with open(out_path, "wb") as f:
+        pickle.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""SparkSession surface for the conformance shim (see pyspark/__init__)."""
+
+from __future__ import annotations
+
+import os
+
+from pyspark import SparkContext
+
+
+class _Builder:
+    def getOrCreate(self) -> "SparkSession":
+        return SparkSession()
+
+
+class SparkSession:
+    builder = _Builder()
+
+    def __init__(self):
+        self.sparkContext = SparkContext(
+            int(os.environ.get("PYSPARK_SHIM_PARALLELISM", "2")))
+
+    @staticmethod
+    def getActiveSession():
+        # Estimators probe this to pick a backend; the shim only serves
+        # explicit spark.run() calls, so there is no ambient session.
+        return None

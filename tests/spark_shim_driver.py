@@ -1,0 +1,93 @@
+"""Driver program for the ``spark.run()`` end-to-end execution test.
+
+Runs under ``tests/pyspark_shim`` on PYTHONPATH (see that package's
+docstring): ``horovod_tpu.spark.run`` executes for real — driver-side
+RendezvousServer, per-task env contract, worker processes calling
+``hvd.init()`` and eager collectives over the live engine gang —
+with only the Spark task scheduler shimmed.
+
+Scenarios:
+  1. run() with explicit num_proc: rank-ordered results, correct gang
+     arithmetic, rank/local_rank/cross_rank wiring.
+  2. run() with num_proc=None: picks up sc.defaultParallelism.
+  3. TorchEstimator.fit through SparkBackend (the barrier path the
+     estimators take when a Spark session is live).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def train():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    out = hvd.allreduce(np.ones(4) * (hvd.rank() + 1), op=hvd.Sum,
+                        name="spark.t")
+    bcast = hvd.broadcast(np.arange(3.0) if hvd.rank() == 0
+                          else np.zeros(3), root_rank=0, name="spark.b")
+    return (float(out[0]), list(map(float, bcast)), hvd.rank(),
+            hvd.size(), hvd.local_rank(), hvd.cross_size())
+
+
+def main() -> None:
+    import pyspark  # the shim — fails loudly if PYTHONPATH is wrong
+
+    assert hasattr(pyspark, "BarrierTaskContext")
+    import horovod_tpu.spark as hvd_spark
+
+    assert hvd_spark._HAVE_PYSPARK, "shim not picked up"
+
+    # 1. explicit num_proc
+    results = hvd_spark.run(train, num_proc=2, verbose=0)
+    assert [r[2] for r in results] == [0, 1], results
+    assert all(r[0] == 3.0 for r in results), results
+    assert all(r[1] == [0.0, 1.0, 2.0] for r in results), results
+    assert all(r[3] == 2 for r in results), results
+    assert [r[4] for r in results] == [0, 1], results  # same host
+    print("scenario 1 ok: spark.run 2-rank gang")
+
+    # 2. default parallelism
+    os.environ["PYSPARK_SHIM_PARALLELISM"] = "3"
+    results = hvd_spark.run(train, num_proc=None, verbose=0)
+    assert len(results) == 3 and all(r[3] == 3 for r in results), results
+    assert all(r[0] == 6.0 for r in results), results
+    print("scenario 2 ok: num_proc from defaultParallelism")
+
+    # 3. estimator through the Spark barrier backend
+    import numpy as np
+    import pandas as pd
+    import torch
+
+    from horovod_tpu.spark import SparkBackend, TorchEstimator
+    from horovod_tpu.spark.store import Store
+
+    rs = np.random.RandomState(3)
+    X = rs.randn(192, 5).astype(np.float32)
+    w = rs.randn(5, 1).astype(np.float32)
+    y = (X @ w).ravel()
+    df = pd.DataFrame({"features": list(X), "label": y})
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        est = TorchEstimator(
+            torch.nn.Linear(5, 1),
+            optimizer=torch.optim.SGD(
+                torch.nn.Linear(5, 1).parameters(), lr=0.05),
+            loss=torch.nn.MSELoss(),
+            feature_cols=["features"], label_cols=["label"],
+            batch_size=32, epochs=3, num_proc=2,
+            store=Store.create(td), backend=SparkBackend(2))
+        fitted = est.fit(df)
+    assert fitted.history[-1] < fitted.history[0], fitted.history
+    print("scenario 3 ok: TorchEstimator via SparkBackend barrier mode")
+
+    print("SPARK_RUN_E2E_OK")
+
+
+if __name__ == "__main__":
+    main()
