@@ -93,6 +93,34 @@ def capture(out_name: str) -> bool:
             f" — JSON written to {out_name}, request kept for retry")
         return False
     log(f"captured + committed {out_name}: {json.dumps(line)[:300]}")
+    # Same window: measure the allreduce/backward overlap fraction from
+    # the TPU compiler's actual schedule (tools/measure_overlap.py;
+    # compile-only, so it is cheap relative to the bench).
+    for model in ("resnet", "transformer"):
+        out = f"OVERLAP_TPU_{model}.json"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "tools/measure_overlap.py",
+                 "--model", model, "--out", out],
+                timeout=900, capture_output=True, text=True, cwd=REPO)
+            if proc.returncode == 0:
+                add = subprocess.run(["git", "add", "--", out], cwd=REPO,
+                                     capture_output=True, text=True)
+                com = subprocess.run(
+                    ["git", "commit", "-m",
+                     f"Measured allreduce overlap fraction ({model})",
+                     "--", out], cwd=REPO, capture_output=True,
+                    text=True)
+                if add.returncode or com.returncode:
+                    log(f"overlap({model}) measured but commit FAILED: "
+                        f"{(add.stderr + com.stderr)[-200:]} — JSON left "
+                        f"in {out}")
+                else:
+                    log(f"overlap({model}): {proc.stdout.strip()[:200]}")
+            else:
+                log(f"overlap({model}) failed: {proc.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            log(f"overlap({model}) timed out")
     return True
 
 
