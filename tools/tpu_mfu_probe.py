@@ -1,0 +1,140 @@
+"""One-window ResNet-50 MFU experiment sweep (VERDICT r5 item 2).
+
+The tunnel gives unpredictable, short windows on the real chip; this
+script packs the MFU-relevant experiments into one run so a single
+window answers them all.  Each experiment times the steady-state
+(dispatch-amortized, 50-step-chain) protocol from bench.py and reports
+images/sec + MFU.
+
+Experiments:
+  base-b32      current model (s2d stem, bf16 BN apply), batch 32
+  plainstem-b32 stem_s2d=False — isolates the stem rewrite's effect
+  base-b128     batch 128 (same protocol — the r4 b128<b32 anomaly
+                check with memory freed between runs)
+  base-b256     batch 256 (MXU headroom; may OOM — reported as error)
+  bf16input-b32 input images pre-cast to bf16 on host (halves H2D and
+                the first conv's HBM reads)
+
+Usage: python tools/tpu_mfu_probe.py [--quick]
+Writes MFU_PROBE.json incrementally (a tunnel death mid-sweep keeps the
+completed experiments); one line per experiment on stdout.  Exits
+nonzero unless at least one experiment produced a measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer timed chains (flakier, faster)")
+    ap.add_argument("--out", default="MFU_PROBE.json")
+    args = ap.parse_args()
+
+    from horovod_tpu.utils.platform import default_backend_alive
+
+    alive, errors = default_backend_alive(timeout=75.0, attempts=1)
+    if not alive:
+        print(json.dumps({"error": f"tunnel down: {errors}"}))
+        sys.exit(2)
+
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import resnet
+    from horovod_tpu.parallel import mesh as mesh_mod
+    from horovod_tpu.parallel import train as train_mod
+
+    from bench import _peak_flops  # noqa: E402  (repo root on path)
+
+    devices = jax.devices()
+    if devices[0].platform != "tpu":
+        print(json.dumps({"error": "not on tpu"}))
+        sys.exit(2)
+    peak = _peak_flops(devices[0].device_kind) or 197e12
+    mesh = mesh_mod.make_mesh({"dp": 1}, devices=devices[:1])
+    iters, chain = (3, 30) if args.quick else (5, 50)
+
+    base_cfg = resnet.resnet50_config()
+    results = {"device_kind": devices[0].device_kind, "peak_flops": peak,
+               "iters": iters, "chain": chain, "experiments": {}}
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), args.out)
+
+    def flush_results():
+        # Incremental: a tunnel death mid-sweep (the hang-not-error
+        # failure mode) keeps every completed experiment on disk.
+        with open(out_path, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+
+    def run_exp(label, cfg, batch, cast_bf16=False):
+        try:
+            rs = np.random.RandomState(0)
+            images = jnp.asarray(rs.rand(batch, 224, 224, 3),
+                                 jnp.bfloat16 if cast_bf16
+                                 else jnp.float32)
+            labels = jnp.asarray(rs.randint(0, cfg.num_classes, (batch,)))
+            step, init = train_mod.make_resnet_train_step(
+                cfg, mesh, optax.sgd(0.01, momentum=0.9))
+            state = init(jax.random.PRNGKey(0))
+            # One compile total: run warmup/timing through the AOT
+            # executable (every relay round-trip is a hang risk).
+            compiled = step.lower(state, images, labels).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0]
+            flops = float(ca.get("flops", 0.0))
+            for _ in range(2):
+                state, loss = compiled(state, images, labels)
+            float(np.asarray(loss).ravel()[0])
+            rates = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                for _ in range(chain):
+                    state, loss = compiled(state, images, labels)
+                float(np.asarray(loss).ravel()[0])
+                rates.append(batch * chain
+                             / (time.perf_counter() - t0))
+            rate = float(np.median(rates))
+            entry = {"images_per_sec": round(rate, 2),
+                     "mfu": round(flops * rate / batch / peak, 4),
+                     "step_flops": flops,
+                     "loss_finite": bool(np.isfinite(
+                         float(np.asarray(loss).ravel()[0])))}
+        except Exception as e:
+            entry = {"error": f"{type(e).__name__}: {e}"[:300]}
+        results["experiments"][label] = entry
+        flush_results()
+        print(json.dumps({label: entry}), flush=True)
+
+    run_exp("base-b32", base_cfg, 32)
+    run_exp("plainstem-b32",
+            dataclasses.replace(base_cfg, stem_s2d=False), 32)
+    run_exp("base-b128", base_cfg, 128)
+    run_exp("base-b256", base_cfg, 256)
+    run_exp("bf16input-b32", base_cfg, 32, cast_bf16=True)
+
+    measured = [k for k, v in results["experiments"].items()
+                if "images_per_sec" in v]
+    print(json.dumps({"done": True, "out": args.out,
+                      "measured": len(measured)}))
+    if not measured:
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

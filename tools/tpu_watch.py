@@ -93,34 +93,64 @@ def capture(out_name: str) -> bool:
             f" — JSON written to {out_name}, request kept for retry")
         return False
     log(f"captured + committed {out_name}: {json.dumps(line)[:300]}")
-    # Same window: measure the allreduce/backward overlap fraction from
-    # the TPU compiler's actual schedule (tools/measure_overlap.py;
-    # compile-only, so it is cheap relative to the bench).
-    for model in ("resnet", "transformer"):
-        out = f"OVERLAP_TPU_{model}.json"
-        try:
-            proc = subprocess.run(
-                [sys.executable, "tools/measure_overlap.py",
-                 "--model", model, "--out", out],
-                timeout=900, capture_output=True, text=True, cwd=REPO)
-            if proc.returncode == 0:
-                add = subprocess.run(["git", "add", "--", out], cwd=REPO,
-                                     capture_output=True, text=True)
-                com = subprocess.run(
-                    ["git", "commit", "-m",
-                     f"Measured allreduce overlap fraction ({model})",
-                     "--", out], cwd=REPO, capture_output=True,
-                    text=True)
-                if add.returncode or com.returncode:
-                    log(f"overlap({model}) measured but commit FAILED: "
-                        f"{(add.stderr + com.stderr)[-200:]} — JSON left "
-                        f"in {out}")
-                else:
-                    log(f"overlap({model}): {proc.stdout.strip()[:200]}")
-            else:
-                log(f"overlap({model}) failed: {proc.stderr[-200:]}")
-        except subprocess.TimeoutExpired:
-            log(f"overlap({model}) timed out")
+    # Same window: the overlap-fraction measurements (compile-only,
+    # cheap), then the MFU experiment sweep — longest job last so a
+    # dying tunnel costs the least-critical capture.
+    for label, cmd, timeout, artifact, msg in [
+        ("overlap(resnet)",
+         ["tools/measure_overlap.py", "--model", "resnet",
+          "--out", "OVERLAP_TPU_resnet.json"], 900,
+         "OVERLAP_TPU_resnet.json",
+         "Measured allreduce overlap fraction (resnet)"),
+        ("overlap(transformer)",
+         ["tools/measure_overlap.py", "--model", "transformer",
+          "--out", "OVERLAP_TPU_transformer.json"], 900,
+         "OVERLAP_TPU_transformer.json",
+         "Measured allreduce overlap fraction (transformer)"),
+        ("mfu probe", ["tools/tpu_mfu_probe.py"], 2400,
+         "MFU_PROBE.json", "ResNet-50 MFU experiment sweep on-chip"),
+    ]:
+        run_and_commit(label, cmd, timeout, artifact, msg)
+    return True
+
+
+def run_and_commit(label: str, cmd, timeout: float, artifact: str,
+                   msg: str) -> bool:
+    """Run a capture tool; on success pathspec-commit its artifact.
+    Always logs stdout+stderr tails so a failed window is diagnosable;
+    commits only when the tool exited 0 AND the artifact exists (the
+    tools exit nonzero when they measured nothing)."""
+    try:
+        proc = subprocess.run([sys.executable] + cmd, timeout=timeout,
+                              capture_output=True, text=True, cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        log(f"{label} timed out; partial stdout: "
+            f"{(e.stdout or '')[-300:]}")
+        # A partially-written artifact (incremental JSON) still counts.
+        proc = None
+    artifact_path = os.path.join(REPO, artifact)
+    if proc is not None and proc.returncode != 0:
+        log(f"{label} failed rc={proc.returncode}: "
+            f"stdout {proc.stdout[-200:]!r} stderr {proc.stderr[-200:]!r}")
+        return False
+    if not os.path.exists(artifact_path):
+        if proc is not None:
+            log(f"{label}: no artifact written; stdout "
+                f"{proc.stdout[-200:]!r}")
+        return False
+    add = subprocess.run(["git", "add", "--", artifact], cwd=REPO,
+                         capture_output=True, text=True)
+    com = subprocess.run(["git", "commit", "-m", msg, "--", artifact],
+                         cwd=REPO, capture_output=True, text=True)
+    if add.returncode or com.returncode:
+        log(f"{label} measured but commit FAILED: "
+            f"{(add.stderr + com.stderr)[-200:]} — JSON left in "
+            f"{artifact}")
+        return False
+    if proc is not None:
+        log(f"{label}: {proc.stdout.strip()[-300:]}")
+    else:
+        log(f"{label}: partial artifact committed after timeout")
     return True
 
 
