@@ -49,6 +49,11 @@ class ResNetConfig:
     # A 3-in-channel conv wastes most of each 128-lane contraction tile;
     # this is the standard TPU ResNet stem rewrite.
     stem_s2d: bool = True
+    # Rematerialize each residual block in the backward pass
+    # (jax.checkpoint): stores only block inputs instead of every
+    # intermediate activation — the standard HBM-for-FLOPs trade that
+    # unlocks large batches (e.g. 256x224x224) on one chip.
+    remat: bool = False
 
     @property
     def bottleneck(self) -> bool:
@@ -276,13 +281,19 @@ def apply(params: Params, batch_stats: Params, images,
         x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
         [(0, 0), (1, 1), (1, 1), (0, 0)])
 
+    block_fn = _block
+    if config.remat:
+        # Static args (stride/basic/train/dtype) stay python-level;
+        # only the array args are checkpointed.
+        block_fn = jax.checkpoint(_block, static_argnums=(3, 4, 5, 6))
+
     cin = config.width
     expansion = 1 if basic else 4
     for si, nblocks in enumerate(config.blocks):
         for bi in range(nblocks):
             name = f"stage{si}_block{bi}"
             stride = 2 if (bi == 0 and si > 0) else 1
-            x, new_stats[name] = _block(
+            x, new_stats[name] = block_fn(
                 x, params[name], batch_stats[name], stride, basic,
                 train, dtype)
 

@@ -77,6 +77,27 @@ def test_stem_s2d_matches_7x7_conv():
     assert logits.shape == (2, 10)
 
 
+def test_resnet_remat_matches_plain():
+    """remat=True is a scheduling change only: loss and gradients must
+    match the plain path to fp tolerance."""
+    import dataclasses
+
+    cfg = small_resnet_cfg()
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    params, stats = resnet.init(jax.random.PRNGKey(0), cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.zeros((4,), jnp.int32)
+    l1, g1 = jax.value_and_grad(
+        lambda p: resnet.loss_fn(p, stats, x, y, cfg)[0])(params)
+    l2, g2 = jax.value_and_grad(
+        lambda p: resnet.loss_fn(p, stats, x, y, cfg_r)[0])(params)
+    assert abs(float(l1) - float(l2)) < 1e-6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                atol=1e-5),
+        g1, g2)
+
+
 def test_resnet50_param_count():
     cfg = resnet.resnet50_config()
     shapes = jax.eval_shape(

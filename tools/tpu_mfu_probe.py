@@ -27,7 +27,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -58,7 +57,12 @@ def main() -> None:
     from horovod_tpu.parallel import mesh as mesh_mod
     from horovod_tpu.parallel import train as train_mod
 
-    from bench import _peak_flops  # noqa: E402  (repo root on path)
+    # Repo root is on sys.path; reuse bench.py's protocol pieces so the
+    # probe and the official bench can never disagree on methodology
+    # (host-readback fence + median — see the note in
+    # bench._timed_images_per_sec about the impossible rate a
+    # block_until_ready fence once produced on the tunnel).
+    from bench import _peak_flops, _timed_images_per_sec  # noqa: E402
 
     devices = jax.devices()
     if devices[0].platform != "tpu":
@@ -75,11 +79,14 @@ def main() -> None:
         os.path.abspath(__file__))), args.out)
 
     def flush_results():
-        # Incremental: a tunnel death mid-sweep (the hang-not-error
-        # failure mode) keeps every completed experiment on disk.
-        with open(out_path, "w") as f:
+        # Incremental + atomic: a tunnel death mid-sweep keeps every
+        # completed experiment on disk, and a SIGKILL mid-write can
+        # never leave truncated JSON (temp + rename).
+        tmp = f"{out_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(results, f, indent=2)
             f.write("\n")
+        os.replace(tmp, out_path)
 
     def run_exp(label, cfg, batch, cast_bf16=False):
         try:
@@ -100,21 +107,13 @@ def main() -> None:
             flops = float(ca.get("flops", 0.0))
             for _ in range(2):
                 state, loss = compiled(state, images, labels)
-            float(np.asarray(loss).ravel()[0])
-            rates = []
-            for _ in range(iters):
-                t0 = time.perf_counter()
-                for _ in range(chain):
-                    state, loss = compiled(state, images, labels)
-                float(np.asarray(loss).ravel()[0])
-                rates.append(batch * chain
-                             / (time.perf_counter() - t0))
-            rate = float(np.median(rates))
+            last = float(np.asarray(loss).ravel()[0])
+            rate, state = _timed_images_per_sec(
+                compiled, state, images, labels, batch, iters, chain)
             entry = {"images_per_sec": round(rate, 2),
                      "mfu": round(flops * rate / batch / peak, 4),
                      "step_flops": flops,
-                     "loss_finite": bool(np.isfinite(
-                         float(np.asarray(loss).ravel()[0])))}
+                     "loss_finite": bool(np.isfinite(last))}
         except Exception as e:
             entry = {"error": f"{type(e).__name__}: {e}"[:300]}
         results["experiments"][label] = entry
@@ -126,6 +125,10 @@ def main() -> None:
             dataclasses.replace(base_cfg, stem_s2d=False), 32)
     run_exp("base-b128", base_cfg, 128)
     run_exp("base-b256", base_cfg, 256)
+    if "error" in results["experiments"]["base-b256"]:
+        # Likely OOM: retry with block-level rematerialization.
+        run_exp("remat-b256",
+                dataclasses.replace(base_cfg, remat=True), 256)
     run_exp("bf16input-b32", base_cfg, 32, cast_bf16=True)
 
     measured = [k for k, v in results["experiments"].items()

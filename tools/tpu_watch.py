@@ -120,6 +120,11 @@ def run_and_commit(label: str, cmd, timeout: float, artifact: str,
     Always logs stdout+stderr tails so a failed window is diagnosable;
     commits only when the tool exited 0 AND the artifact exists (the
     tools exit nonzero when they measured nothing)."""
+    artifact_path = os.path.join(REPO, artifact)
+    # Snapshot so a stale artifact from a previous window can never be
+    # committed as this run's measurement.
+    before_mtime = (os.path.getmtime(artifact_path)
+                    if os.path.exists(artifact_path) else None)
     try:
         proc = subprocess.run([sys.executable] + cmd, timeout=timeout,
                               capture_output=True, text=True, cwd=REPO)
@@ -128,15 +133,18 @@ def run_and_commit(label: str, cmd, timeout: float, artifact: str,
             f"{(e.stdout or '')[-300:]}")
         # A partially-written artifact (incremental JSON) still counts.
         proc = None
-    artifact_path = os.path.join(REPO, artifact)
     if proc is not None and proc.returncode != 0:
         log(f"{label} failed rc={proc.returncode}: "
             f"stdout {proc.stdout[-200:]!r} stderr {proc.stderr[-200:]!r}")
         return False
-    if not os.path.exists(artifact_path):
+    fresh = (os.path.exists(artifact_path)
+             and os.path.getmtime(artifact_path) != before_mtime)
+    if not fresh:
         if proc is not None:
-            log(f"{label}: no artifact written; stdout "
+            log(f"{label}: no fresh artifact written; stdout "
                 f"{proc.stdout[-200:]!r}")
+        else:
+            log(f"{label}: timed out before writing anything")
         return False
     add = subprocess.run(["git", "add", "--", artifact], cwd=REPO,
                          capture_output=True, text=True)
