@@ -10,12 +10,16 @@ rendezvous against the driver's HTTP server exactly like `hvdrun`
 workers do, so the whole coordination stack is shared with the plain
 launcher.
 
-``run()`` is gated on the ``pyspark`` package (not shipped in this
-environment).  The Estimator API (``horovod/spark/common/estimator.py``)
-is NOT: it materializes to parquet with pyarrow and can execute through
-either the Spark barrier backend or the plain launcher
-(``spark/estimator.py``), so ``TorchEstimator``/``KerasEstimator`` run —
-and are tested — without a Spark cluster.
+``run()`` is gated on the ``pyspark`` package (not installable in this
+environment — see ``docs/spark_descope.md``), but it is *executed*
+end-to-end by ``tests/test_spark.py::test_run_executes_under_barrier_shim``
+against a pyspark-API conformance shim whose barrier tasks are real
+separate processes.  The Estimator API
+(``horovod/spark/common/estimator.py``) is not gated at all: it
+materializes to parquet with pyarrow and can execute through either the
+Spark barrier backend or the plain launcher (``spark/estimator.py``),
+so ``TorchEstimator``/``KerasEstimator`` run — and are tested — without
+a Spark cluster.
 """
 
 from __future__ import annotations
