@@ -45,20 +45,13 @@ def _to_pandas(df):
     return pd.DataFrame({k: list(np.asarray(v)) for k, v in df.items()})
 
 
-def materialize(df, store: Store, run_id: str, num_shards: int) -> int:
-    """Write ``df`` as ``num_shards`` parquet shards (shard i is rank i's
-    training data).  Returns the total row count.  Parity:
-    ``util.prepare_data`` + Petastorm materialization in
-    ``spark/common/util.py``."""
+def _write_shards(pdf, store: Store, path: str, num_shards: int) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    pdf = _to_pandas(df)
-    path = store.train_data_path(run_id)
     store.delete(path)
     store.makedirs(path)
-    n = len(pdf)
-    bounds = np.linspace(0, n, num_shards + 1).astype(int)
+    bounds = np.linspace(0, len(pdf), num_shards + 1).astype(int)
     for i in range(num_shards):
         shard = pdf.iloc[bounds[i]:bounds[i + 1]]
         # Through the store's own open() so remote (fsspec) stores get
@@ -66,13 +59,64 @@ def materialize(df, store: Store, run_id: str, num_shards: int) -> int:
         with store.open(store.join(path, f"part-{i:05d}.parquet"),
                         "wb") as f:
             pq.write_table(pa.Table.from_pandas(shard), f)
-    return n
+
+
+def materialize(df, store: Store, run_id: str, num_shards: int,
+                validation=None, seed: int = 0) -> int:
+    """Write ``df`` as ``num_shards`` parquet shards (shard i is rank i's
+    training data).  Returns the training row count.  Parity:
+    ``util.prepare_data`` + Petastorm materialization in
+    ``spark/common/util.py``.
+
+    ``validation`` (parity: common/params.py:52 + util.py:426-449):
+    a float in (0, 1) holds out that fraction of rows (seeded shuffle);
+    a string names an indicator column — truthy rows become the
+    validation set and the column is dropped from both splits.
+    Validation shards land in ``store.val_data_path(run_id)``.
+    """
+    pdf = _to_pandas(df)
+    val_pdf = None
+    if validation is not None:
+        if isinstance(validation, str):
+            mask = pdf[validation].astype(bool).to_numpy()
+            val_pdf = pdf[mask].drop(columns=[validation])
+            pdf = pdf[~mask].drop(columns=[validation])
+        elif isinstance(validation, float) and 0 < validation < 1:
+            rs = np.random.RandomState(seed)
+            idx = rs.permutation(len(pdf))
+            n_val = int(round(len(pdf) * validation))
+            val_pdf = pdf.iloc[idx[:n_val]]
+            pdf = pdf.iloc[idx[n_val:]]
+        else:
+            raise ValueError(
+                f"validation must be a float in (0, 1) or a column "
+                f"name, got {validation!r}")
+    if val_pdf is not None and len(val_pdf) < num_shards:
+        # Fail here, not as a per-rank shape error mid-collective: an
+        # empty shard on some ranks only would desync the epoch-end
+        # val-loss allreduce.
+        raise ValueError(
+            f"validation selected {len(val_pdf)} row(s) but the job "
+            f"has {num_shards} ranks; every rank needs at least one "
+            "validation row — increase the fraction or provide more "
+            "rows")
+    _write_shards(pdf, store, store.train_data_path(run_id), num_shards)
+    if val_pdf is not None:
+        _write_shards(val_pdf, store, store.val_data_path(run_id),
+                      num_shards)
+    return len(pdf)
 
 
 def columns_to_matrix(pdf, cols: Sequence[str]) -> np.ndarray:
     """Dense float32 matrix from DataFrame columns.  Columns holding
     vectors (lists/arrays) are stacked; scalars become width-1 features,
     matching the reference's flattening of Spark vector columns."""
+    if len(pdf) == 0:
+        # An empty frame cannot reveal vector-column widths; the caller
+        # would get a wrong-shaped matrix and fail later, possibly on
+        # only some ranks of a collective.
+        raise ValueError("cannot build a feature matrix from an empty "
+                         "shard")
     parts = []
     for c in cols:
         col = pdf[c].to_numpy()
@@ -84,15 +128,17 @@ def columns_to_matrix(pdf, cols: Sequence[str]) -> np.ndarray:
 
 
 def read_shard(store: Store, run_id: str, rank: int, size: int,
-               feature_cols: Sequence[str], label_cols: Sequence[str]):
+               feature_cols: Sequence[str], label_cols: Sequence[str],
+               val: bool = False):
     """Load this rank's shard(s) back as dense float32 arrays."""
     import pyarrow.parquet as pq
 
-    paths = store.shard_paths(run_id)
+    paths = store.shard_paths(run_id, val=val)
     mine = paths[rank::size] if len(paths) != size else [paths[rank]]
     if not mine:
         raise ValueError(
-            f"rank {rank}: no training shard — {len(paths)} shard(s) were "
+            f"rank {rank}: no {'validation' if val else 'training'} "
+            f"shard — {len(paths)} shard(s) were "
             f"materialized but the job has {size} ranks; set the "
             f"estimator's num_proc to the actual world size")
 
@@ -162,12 +208,20 @@ class HorovodEstimator:
     def __init__(self, *, feature_cols=("features",), label_cols=("label",),
                  batch_size=32, epochs=1, num_proc=2, store=None,
                  backend=None, run_id=None, verbose=1, seed=1234,
-                 resume=True):
+                 resume=True, validation=None):
         """``resume=True`` (default, matching the reference's
         torch/remote.py contract): a fit whose ``run_id`` already has
         epoch checkpoints in the store continues from the newest one.
         ``resume=False`` deletes the run's directory first so the fit
-        is clean even under a reused ``run_id``."""
+        is clean even under a reused ``run_id``.
+
+        ``validation`` (parity: common/params.py:52): float fraction in
+        (0, 1) or an indicator column name; held-out rows are scored
+        each epoch with the cross-rank-averaged validation loss.  Keras
+        reports it as ``fitted.history["val_loss"]``; torch as the
+        ``fitted.val_history`` list (``fitted.history`` stays the flat
+        train-loss list), aligned by epoch (``None`` for epochs that
+        ran before validation was enabled)."""
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
         self.batch_size = batch_size
@@ -180,13 +234,15 @@ class HorovodEstimator:
         self.verbose = verbose
         self.seed = seed
         self.resume = resume
+        self.validation = validation
 
     def _fit(self, df, train_fn_builder) -> Dict[str, Any]:
         run_id = self.run_id or f"run-{uuid.uuid4().hex[:8]}"
         self._last_run_id = run_id
         if not self.resume:
             self.store.delete(self.store.run_path(run_id))
-        materialize(df, self.store, run_id, self.num_proc)
+        materialize(df, self.store, run_id, self.num_proc,
+                    validation=self.validation, seed=self.seed)
         backend = self.backend or default_backend(self.num_proc)
         results = backend.run(train_fn_builder(run_id))
         arts = next(r for r in results if r is not None)
@@ -236,6 +292,7 @@ class TorchEstimator(HorovodEstimator):
             self.store, self.feature_cols, self.label_cols)
         batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
         classification = self.classification
+        has_validation = self.validation is not None
 
         def build(run_id):
             def _train():
@@ -246,6 +303,11 @@ class TorchEstimator(HorovodEstimator):
                 rank, size = hvd.rank(), hvd.size()
                 X, y = read_shard(store, run_id, rank, size,
                                   feature_cols, label_cols)
+                Xv = yv = None
+                if has_validation:
+                    Xv, yv = read_shard(store, run_id, rank, size,
+                                        feature_cols, label_cols,
+                                        val=True)
                 # Classification losses take 1-D class indices; the
                 # parquet shards carry labels as float32 matrices
                 # (parity: the reference feeds NLLLoss int targets in
@@ -269,6 +331,7 @@ class TorchEstimator(HorovodEstimator):
 
                 start_epoch = 0
                 history = []
+                val_history = []
                 ck = store.latest_checkpoint(run_id) if rank == 0 else None
                 flag = hvd.broadcast_object(
                     ck[0] if ck else None, root_rank=0,
@@ -281,9 +344,18 @@ class TorchEstimator(HorovodEstimator):
                         local.load_state_dict(st["model"])
                         dist_opt.load_state_dict(st["optimizer"])
                         history = list(st.get("history", []))
+                        val_history = list(st.get("val_history", []))
                     start_epoch = int(flag) + 1
-                    history = hvd.broadcast_object(
-                        history, root_rank=0, name="est.resume.hist")
+                    history, val_history = hvd.broadcast_object(
+                        (history, val_history), root_rank=0,
+                        name="est.resume.hist")
+                    if Xv is not None and len(val_history) < start_epoch:
+                        # Validation newly enabled on an old run: pad so
+                        # val_history[i] always refers to epoch i (None
+                        # = epoch ran without validation).
+                        val_history = ([None] * (start_epoch
+                                                 - len(val_history))
+                                       + val_history)
                 # Optimizer state FIRST: on a fresh optimizer its
                 # broadcast initializes state via a root-only zero-grad
                 # step, which can move root's params (e.g. AdamW's
@@ -315,11 +387,37 @@ class TorchEstimator(HorovodEstimator):
                         torch.tensor([total / max(nb, 1)]),
                         op=hvd.Average, name=f"est.loss.{_epoch}")[0])
                     history.append(avg)
+                    if Xv is not None:
+                        # eval mode (frozen BN stats, no dropout) and
+                        # the training batch size — a whole-shard
+                        # forward would peak memory far above training.
+                        # Sum+count allreduce: exact mean under uneven
+                        # per-rank validation rows.
+                        local.eval()
+                        vtotal, vn = 0.0, 0
+                        with torch.no_grad():
+                            for i in range(0, len(Xv), batch_size):
+                                xb = torch.from_numpy(
+                                    Xv[i:i + batch_size])
+                                yb = torch.from_numpy(
+                                    yv[i:i + batch_size])
+                                if classify:
+                                    yb = yb.reshape(-1).long()
+                                vtotal += float(
+                                    loss_fn(local(xb), yb)) * len(xb)
+                                vn += len(xb)
+                        local.train()
+                        agg = hvd.allreduce(
+                            torch.tensor([vtotal, float(vn)]),
+                            op=hvd.Sum, name=f"est.vloss.{_epoch}")
+                        val_history.append(
+                            float(agg[0]) / max(float(agg[1]), 1.0))
                     if rank == 0:
                         buf = _io.BytesIO()
                         torch.save({"model": local.state_dict(),
                                     "optimizer": dist_opt.state_dict(),
-                                    "history": history}, buf)
+                                    "history": history,
+                                    "val_history": val_history}, buf)
                         store.save_checkpoint(run_id, _epoch,
                                               buf.getvalue())
                 if rank == 0:
@@ -330,7 +428,8 @@ class TorchEstimator(HorovodEstimator):
                     return {"state_dict": {
                         k: v.detach().cpu().numpy()
                         for k, v in local.state_dict().items()},
-                        "history": history}
+                        "history": history,
+                        "val_history": val_history}
                 return None
 
             return _train
@@ -342,7 +441,8 @@ class TorchEstimator(HorovodEstimator):
              for k, v in arts["state_dict"].items()})
         return TorchModel(fitted, self.feature_cols, self.label_cols,
                           history=arts["history"],
-                          run_id=self._last_run_id)
+                          run_id=self._last_run_id,
+                          val_history=arts.get("val_history"))
 
 
 class _FittedModel:
@@ -351,12 +451,13 @@ class _FittedModel:
     DataFrames)."""
 
     def __init__(self, model, feature_cols, label_cols, history=None,
-                 run_id=None):
+                 run_id=None, val_history=None):
         self._model = model
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
         self.history = history
         self.run_id = run_id
+        self.val_history = list(val_history or [])
 
     def getModel(self):
         return self._model
@@ -384,6 +485,37 @@ class TorchModel(_FittedModel):
 # ---------------------------------------------------------------------------
 
 
+def _alias_registered_names(model_json: str, custom_objects):
+    """Extend a plain-name custom_objects mapping with the
+    ``package>Name`` registered-name keys keras 3 actually looks up.
+
+    Workers receive custom classes by cloudpickle, which does not
+    re-run ``@register_keras_serializable`` — their registry is empty
+    and ``deserialize_keras_object`` resolves classes by
+    ``registered_name``.  The architecture JSON carries both names, so
+    the alias map is derivable without asking the user for keras-3
+    registry syntax."""
+    if not custom_objects:
+        return {}
+    import json as _json
+
+    out = dict(custom_objects)
+
+    def walk(node):
+        if isinstance(node, dict):
+            rn, cn = node.get("registered_name"), node.get("class_name")
+            if rn and cn and cn in custom_objects:
+                out[rn] = custom_objects[cn]
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(_json.loads(model_json))
+    return out
+
+
 class KerasEstimator(HorovodEstimator):
     """Parity: ``horovod/spark/keras/estimator.py`` — the model travels as
     architecture JSON + weights (the reference serializes the compiled
@@ -391,12 +523,16 @@ class KerasEstimator(HorovodEstimator):
     """
 
     def __init__(self, model, optimizer=None, loss="mse", metrics=(),
-                 **kw):
+                 custom_objects=None, **kw):
+        """``custom_objects``: name → class/function mapping consulted
+        when the architecture JSON is rebuilt on the workers and the
+        driver (parity: keras/estimator.py custom_objects param)."""
         super().__init__(**kw)
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
         self.metrics = list(metrics)
+        self.custom_objects = custom_objects
 
     def fit(self, df) -> "KerasModel":
         import keras
@@ -406,6 +542,8 @@ class KerasEstimator(HorovodEstimator):
         opt_cfg = keras.optimizers.serialize(
             self.optimizer or keras.optimizers.SGD(learning_rate=0.01))
         loss, metrics = self.loss, self.metrics
+        custom_objects = self.custom_objects
+        has_validation = self.validation is not None
         store, feature_cols, label_cols = (
             self.store, self.feature_cols, self.label_cols)
         batch_size, epochs = self.batch_size, self.epochs
@@ -423,7 +561,20 @@ class KerasEstimator(HorovodEstimator):
                 rank, size = hvd.rank(), hvd.size()
                 X, y = read_shard(store, run_id, rank, size,
                                   feature_cols, label_cols)
-                local = keras.models.model_from_json(model_json)
+                val_data = None
+                if has_validation:
+                    Xv, yv = read_shard(store, run_id, rank, size,
+                                        feature_cols, label_cols,
+                                        val=True)
+                    val_data = (Xv, yv)
+                # custom_object_scope with registered-name aliases, not
+                # the model_from_json kwarg: keras 3 resolves classes
+                # by 'package>Name' and drops the kwarg's mapping in
+                # nested from_config calls.
+                with keras.saving.custom_object_scope(
+                        _alias_registered_names(model_json,
+                                                custom_objects)):
+                    local = keras.models.model_from_json(model_json)
                 local.set_weights(weights)
                 opt = hvd_keras.DistributedOptimizer(
                     keras.optimizers.deserialize(copy.deepcopy(opt_cfg)))
@@ -482,6 +633,7 @@ class KerasEstimator(HorovodEstimator):
                     local.fit(
                         X, y, batch_size=batch_size,
                         epochs=epochs - start_epoch, verbose=0,
+                        validation_data=val_data,
                         callbacks=[
                             hvd_keras.callbacks
                             .BroadcastGlobalVariablesCallback(0),
@@ -513,7 +665,9 @@ class KerasEstimator(HorovodEstimator):
             return _train
 
         arts = self._fit(df, build)
-        fitted = keras.models.model_from_json(model_json)
+        with keras.saving.custom_object_scope(
+                _alias_registered_names(model_json, self.custom_objects)):
+            fitted = keras.models.model_from_json(model_json)
         fitted.set_weights(arts["weights"])
         return KerasModel(fitted, self.feature_cols, self.label_cols,
                           history=arts["history"],

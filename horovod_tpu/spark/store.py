@@ -62,6 +62,11 @@ class Store:
         return self.join(self.prefix_path, "intermediate_train_data",
                           run_id)
 
+    def val_data_path(self, run_id: str) -> str:
+        """Validation shards (parity: store.py get_val_data_path)."""
+        return self.join(self.prefix_path, "intermediate_val_data",
+                          run_id)
+
     def run_path(self, run_id: str) -> str:
         return self.join(self.prefix_path, "runs", run_id)
 
@@ -93,8 +98,10 @@ class Store:
 
     # -- shared helpers built on the ops --------------------------------
 
-    def shard_paths(self, run_id: str) -> List[str]:
-        return sorted(p for p in self.listdir(self.train_data_path(run_id))
+    def shard_paths(self, run_id: str, val: bool = False) -> List[str]:
+        d = (self.val_data_path(run_id) if val
+             else self.train_data_path(run_id))
+        return sorted(p for p in self.listdir(d)
                       if p.endswith(".parquet"))
 
     def read_bytes(self, path: str) -> bytes:

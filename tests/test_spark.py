@@ -279,3 +279,126 @@ def test_keras_estimator_fit_fsspec_store_and_resume(tmp_path):
     np.testing.assert_allclose(losses[:2], first.history["loss"],
                                rtol=1e-6)
     assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_materialize_validation_split_and_column(tmp_path):
+    from horovod_tpu.spark.estimator import materialize, read_shard
+    from horovod_tpu.spark.store import Store
+
+    df, X, y = _teacher_frame(100, 4)
+    store = Store.create(str(tmp_path))
+
+    # float split: 20% held out, train+val partition the dataset
+    n_train = materialize(df, store, "rv", 2, validation=0.2, seed=7)
+    assert n_train == 80
+    assert len(store.shard_paths("rv")) == 2
+    assert len(store.shard_paths("rv", val=True)) == 2
+    Xt = np.concatenate([read_shard(store, "rv", r, 2, ["features"],
+                                    ["label"])[0] for r in range(2)])
+    Xv = np.concatenate([read_shard(store, "rv", r, 2, ["features"],
+                                    ["label"], val=True)[0]
+                         for r in range(2)])
+    assert len(Xt) == 80 and len(Xv) == 20
+    both = np.vstack([Xt, Xv])
+    assert both.shape == X.shape
+    # same rows, different order
+    np.testing.assert_allclose(
+        np.sort(both.sum(axis=1)), np.sort(X.sum(axis=1)), rtol=1e-5)
+
+    # column mode: indicator column selects validation rows, dropped
+    df2 = df.copy()
+    df2["is_val"] = ([1] * 10 + [0] * 90)
+    n2 = materialize(df2, store, "rc", 2, validation="is_val")
+    assert n2 == 90
+    import pyarrow.parquet as pq
+
+    with store.open(store.shard_paths("rc")[0], "rb") as f:
+        cols = pq.read_table(f).to_pandas().columns
+    assert "is_val" not in cols
+
+    with pytest.raises(ValueError, match="validation"):
+        materialize(df, store, "rx", 2, validation=1.5)
+
+    # fewer validation rows than ranks must fail fast at materialize
+    # time, not as a mid-collective shape error on some ranks
+    with pytest.raises(ValueError, match="at least one validation row"):
+        materialize(df.head(10), store, "ry", 4, validation=0.1)
+
+
+def test_torch_estimator_validation_history(tmp_path):
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalBackend, TorchEstimator
+    from horovod_tpu.spark.store import Store
+
+    df, X, y = _teacher_frame()
+    model = torch.nn.Linear(6, 1)
+    est = TorchEstimator(
+        model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.05),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=3, num_proc=2,
+        store=Store.create(str(tmp_path)),
+        backend=LocalBackend(2), validation=0.25)
+    fitted = est.fit(df)
+    assert len(fitted.val_history) == 3, fitted.val_history
+    # teacher task: validation loss falls too
+    assert fitted.val_history[-1] < fitted.val_history[0], \
+        fitted.val_history
+
+
+def test_keras_estimator_validation_history(tmp_path):
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator, LocalBackend
+    from horovod_tpu.spark.store import Store
+
+    df, X, y = _teacher_frame(128, 4, seed=5)
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        keras.layers.Dense(1),
+    ])
+    est = KerasEstimator(
+        model,
+        optimizer=keras.optimizers.SGD(learning_rate=0.05),
+        loss="mse",
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=3, num_proc=2,
+        store=Store.create(str(tmp_path)),
+        backend=LocalBackend(2), validation=0.25)
+    fitted = est.fit(df)
+    assert "val_loss" in fitted.history, fitted.history.keys()
+    assert len(fitted.history["val_loss"]) == 3
+    assert fitted.history["val_loss"][-1] < fitted.history["val_loss"][0]
+
+
+def test_keras_estimator_custom_objects(tmp_path):
+    """A model with a registered custom layer trains through the
+    estimator: workers receive the class by cloudpickle (no decorator
+    re-run), so deserialization must resolve it via the estimator's
+    registered-name aliasing of custom_objects."""
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import KerasEstimator, LocalBackend
+    from horovod_tpu.spark.store import Store
+
+    @keras.saving.register_keras_serializable(package="hvdtest")
+    class Scale2(keras.layers.Layer):
+        def call(self, x):
+            return x * 2.0
+
+    df, X, y = _teacher_frame(64, 4)
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential([
+        keras.layers.Input((4,)),
+        Scale2(),
+        keras.layers.Dense(1),
+    ])
+    est = KerasEstimator(
+        model, loss="mse",
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=16, epochs=2, num_proc=2,
+        store=Store.create(str(tmp_path)), backend=LocalBackend(2),
+        custom_objects={"Scale2": Scale2})
+    fitted = est.fit(df)
+    assert fitted.history["loss"][-1] < fitted.history["loss"][0]
+    assert any(isinstance(l, Scale2) for l in fitted.getModel().layers)
