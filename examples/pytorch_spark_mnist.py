@@ -30,7 +30,12 @@ def main() -> None:
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--work-dir", default=None,
-                   help="Store prefix (default: a temp dir)")
+                   help="Store prefix: a local path or an fsspec URL "
+                        "(gs://bucket/prefix on a pod) "
+                        "(default: a temp dir)")
+    p.add_argument("--validation", type=float, default=0.1,
+                   help="held-out fraction scored every epoch (0 "
+                        "disables)")
     args = p.parse_args()
 
     import torch.nn as nn
@@ -58,12 +63,17 @@ def main() -> None:
         num_proc=args.num_proc,
         batch_size=args.batch_size,
         epochs=args.epochs,
+        validation=args.validation or None,
     )
     fitted = est.fit(df)
+    # A second fit with the same run_id would resume from the per-epoch
+    # checkpoints the store now holds (see fitted.run_id).
 
     pred = fitted.predict(X[:512])
     acc = float(np.mean(np.argmax(pred, axis=1) == y[:512]))
     print(f"train history: {fitted.history}")
+    if fitted.val_history:
+        print(f"validation history: {fitted.val_history}")
     print(f"accuracy on 512 train rows: {acc:.3f}")
     assert acc > 0.5, "estimator fit did not learn the teacher"
     print("DONE")

@@ -1,0 +1,73 @@
+"""Smoke tests for the unattended capture pipeline in tools/tpu_watch.py
+(run_and_commit) — the r5 real-chip evidence lands through this path
+with nobody watching, so its commit/staleness/failure behavior is
+pinned here against a scratch git repo."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+import tpu_watch  # noqa: E402
+
+
+@pytest.fixture
+def scratch_repo(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    subprocess.run(["git", "init", "-q"], cwd=repo, check=True)
+    subprocess.run(["git", "config", "user.email", "t@t"], cwd=repo,
+                   check=True)
+    subprocess.run(["git", "config", "user.name", "t"], cwd=repo,
+                   check=True)
+    monkeypatch.setattr(tpu_watch, "REPO", str(repo))
+    monkeypatch.setattr(tpu_watch, "LOG", str(tmp_path / "watch.log"))
+    return repo
+
+
+def _git_log(repo):
+    return subprocess.run(["git", "log", "--oneline"], cwd=repo,
+                          capture_output=True, text=True).stdout
+
+
+def test_run_and_commit_success(scratch_repo):
+    ok = tpu_watch.run_and_commit(
+        "t", ["-c", "open('art.json','w').write('{}')"], 60,
+        "art.json", "test artifact")
+    assert ok
+    assert "test artifact" in _git_log(scratch_repo)
+
+
+def test_run_and_commit_tool_failure_not_committed(scratch_repo):
+    ok = tpu_watch.run_and_commit(
+        "t", ["-c", "import sys; sys.exit(3)"], 60,
+        "art.json", "should not appear")
+    assert not ok
+    assert "should not appear" not in _git_log(scratch_repo)
+
+
+def test_run_and_commit_stale_artifact_not_recommitted(scratch_repo):
+    """A tool that exits 0 without touching the artifact must not get a
+    previous window's file committed as a fresh measurement."""
+    art = scratch_repo / "art.json"
+    art.write_text("{\"old\": true}")
+    ok = tpu_watch.run_and_commit(
+        "t", ["-c", "pass"], 60, "art.json", "stale must not commit")
+    assert not ok
+    assert "stale must not commit" not in _git_log(scratch_repo)
+
+
+def test_run_and_commit_artifact_without_exit_zero(scratch_repo):
+    """Nonzero exit wins even when an artifact was written (e.g. the
+    mfu probe's all-error sweep exits 3 after flushing)."""
+    ok = tpu_watch.run_and_commit(
+        "t", ["-c",
+              "open('art.json','w').write('{}'); import sys; sys.exit(3)"],
+        60, "art.json", "errors must not commit")
+    assert not ok
+    assert "errors must not commit" not in _git_log(scratch_repo)

@@ -34,7 +34,7 @@ BENCH_TIMEOUT = 1800.0
 
 
 def log(msg: str) -> None:
-    stamp = datetime.datetime.utcnow().strftime("%Y-%m-%d %H:%M:%S")
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
     line = f"[{stamp} UTC] {msg}"
     print(line, flush=True)
     with open(LOG, "a") as f:
@@ -170,7 +170,7 @@ def main() -> None:
         up = probe()
         if up:
             with open(MARKER, "w") as f:
-                f.write(datetime.datetime.utcnow().isoformat() + "\n")
+                f.write(datetime.datetime.now(datetime.timezone.utc).isoformat() + "\n")
             log("probe: UP")
             if os.path.exists(REQUEST):
                 with open(REQUEST) as f:
