@@ -87,3 +87,31 @@ ENTRY %main () -> f32[] {
     # compute time is tiny (40 bytes); hidden must equal it exactly
     # (credited once), not twice.
     assert abs(r["hidden_s_est"] - 40 / 8.1e11) < 1e-15, r
+
+
+def test_measure_entry_bounded_and_non_entry_counted():
+    """Instructions in computations after ENTRY must not enter the
+    schedule walk; collectives in any non-entry computation are counted
+    as a diagnostic (scan/while bodies hide gradient syncs there)."""
+    hlo = """
+HloModule m
+%body (p: f32[10]) -> f32[10] {
+  %p = f32[10]{0} parameter(0)
+  %arb = f32[10]{0} all-reduce(%p), to_apply=%add
+}
+ENTRY %main () -> f32[] {
+  %q = f32[10]{0} parameter(0)
+  %w = f32[10]{0} while(f32[10]{0} %q), body=%body
+}
+%trailing (x: f32[10]) -> f32[10] {
+  %x = f32[10]{0} parameter(0)
+  %art = f32[10]{0} all-reduce(%x), to_apply=%add
+}
+"""
+    r = measure(hlo, 8)
+    # neither the body's nor the trailing computation's all-reduce may
+    # be walked as entry traffic...
+    assert r["sync_allreduces"] == 0
+    assert r["total_collective_s_est"] == 0.0
+    # ...but both are visible in the diagnostic count.
+    assert r["non_entry_collectives"] == 2

@@ -90,6 +90,31 @@ def _inst_cost(rhs: str) -> float:
     return _shape_bytes(rhs) / HBM_BW
 
 
+# One shared collective-op vocabulary for the entry walk and the
+# non-entry diagnostic (a second hand-maintained list would drift).
+_COLLECTIVE_BASES = {"all-reduce", "reduce-scatter", "all-gather",
+                     "all-to-all", "collective-permute",
+                     "collective-broadcast"}
+
+
+def _coll_base(op: str):
+    """('all-reduce', '-start') for 'all-reduce-start'; ('fusion', '')
+    for non-collectives."""
+    for suf in ("-start", "-done"):
+        if op.endswith(suf):
+            return op[: -len(suf)], suf
+    return op, ""
+
+
+def _wire_factor(base: str, n_dev: int) -> float:
+    """Payload multiples crossing the slowest link, by collective."""
+    if base == "all-reduce":
+        return 2 * (n_dev - 1) / n_dev
+    if base in ("reduce-scatter", "all-gather"):
+        return (n_dev - 1) / n_dev
+    return 1.0
+
+
 def _ring_bytes(rhs: str, op: str) -> int:
     """Payload bytes of a collective instruction.
 
@@ -106,10 +131,10 @@ def _ring_bytes(rhs: str, op: str) -> int:
     return b // 2 if op.endswith("-start") else b
 
 
-def _ring_cost(bytes_: int, n_dev: int) -> float:
-    """Ring allreduce wire time: 2(n-1)/n of the payload over the
-    slowest link."""
-    return 2 * (n_dev - 1) / n_dev * bytes_ / ICI_BW
+def _coll_cost(rhs: str, op: str, n_dev: int) -> float:
+    """Wire time for one collective instruction."""
+    base, _ = _coll_base(op)
+    return _wire_factor(base, n_dev) * _ring_bytes(rhs, op) / ICI_BW
 
 
 def measure(hlo: str, n_dev: int):
@@ -122,8 +147,17 @@ def measure(hlo: str, n_dev: int):
     double-credits the same instruction).  At ``all-reduce-done`` any
     remaining time is exposed (the program blocks on it).
     """
-    entry = hlo.split("ENTRY", 1)[-1]
-    lines = [ln.strip() for ln in entry.splitlines() if "=" in ln]
+    # Bound the entry computation at its closing zero-indent brace —
+    # HLO text does not guarantee ENTRY is the last computation, and
+    # walking a trailing computation's instructions would contaminate
+    # the schedule simulation.
+    after = hlo.split("ENTRY", 1)[-1]
+    entry_lines = []
+    for ln in after.splitlines():
+        if ln.rstrip() == "}":
+            break
+        entry_lines.append(ln)
+    lines = [ln.strip() for ln in entry_lines if "=" in ln]
     in_flight: dict = {}   # start-instruction name -> remaining seconds
     total_coll = hidden = 0.0
     async_pairs = sync_ars = 0
@@ -132,20 +166,22 @@ def measure(hlo: str, n_dev: int):
         op = _opcode(rhs)
         if op is None:
             continue
-        if op == "all-reduce-start":
-            name = lhs.strip().lstrip("%")
-            cost = _ring_cost(_ring_bytes(rhs, op), n_dev)
-            in_flight[name] = cost
-            total_coll += cost
-            async_pairs += 1
-        elif op == "all-reduce-done":
-            m = re.search(r"%([\w.\-]+)",
-                          rhs.split(op + "(", 1)[-1])
-            if m:
-                in_flight.pop(m.group(1), None)
-        elif op in ("all-reduce", "reduce-scatter", "all-gather"):
-            sync_ars += 1
-            total_coll += _ring_cost(_ring_bytes(rhs, op), n_dev)
+        base, kind = _coll_base(op)
+        if base in _COLLECTIVE_BASES:
+            if kind == "-start":
+                name = lhs.strip().lstrip("%")
+                cost = _coll_cost(rhs, op, n_dev)
+                in_flight[name] = cost
+                total_coll += cost
+                async_pairs += 1
+            elif kind == "-done":
+                m = re.search(r"%([\w.\-]+)",
+                              rhs.split(op + "(", 1)[-1])
+                if m:
+                    in_flight.pop(m.group(1), None)
+            else:
+                sync_ars += 1
+                total_coll += _coll_cost(rhs, op, n_dev)
         elif op in _COMPUTE_OPS and in_flight:
             rem = _inst_cost(rhs)
             for k in list(in_flight):
@@ -157,9 +193,25 @@ def measure(hlo: str, n_dev: int):
                     del in_flight[k]
                 if rem <= 0:
                     break
+    # Collectives inside non-entry computations (scan/while bodies,
+    # fusion subcomputations) are invisible to the entry walk; report
+    # the count so a capture where the gradient sync compiled into a
+    # loop body reads as "incomplete" rather than silently measuring
+    # only part of the traffic.
+    non_entry = 0
+    entry_set = set(lines)
+    for ln in hlo.splitlines():
+        s = ln.strip()
+        if "=" in s and s not in entry_set:
+            op = _opcode(s.split("=", 1)[1])
+            if op:
+                base, kind = _coll_base(op)
+                if base in _COLLECTIVE_BASES and kind != "-done":
+                    non_entry += 1
     return {
         "async_allreduce_pairs": async_pairs,
         "sync_allreduces": sync_ars,
+        "non_entry_collectives": non_entry,
         "total_collective_s_est": total_coll,
         "hidden_s_est": hidden,
         "overlap_fraction": (hidden / total_coll) if total_coll else None,
