@@ -28,18 +28,9 @@ double GaussianProcess::Kernel(const std::vector<double>& a,
   return sv_ * std::exp(-0.5 * d2 / (ls_ * ls_));
 }
 
-void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
-                          const std::vector<double>& y) {
+double GaussianProcess::Factor(const std::vector<std::vector<double>>& x,
+                               const std::vector<double>& yn) {
   const size_t n = x.size();
-  x_ = x;
-  y_mean_ = 0;
-  for (double v : y) y_mean_ += v;
-  y_mean_ /= n;
-  double var = 0;
-  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
-  y_std_ = n > 1 ? std::sqrt(var / n) : 1.0;
-  if (y_std_ == 0) y_std_ = 1.0;
-
   // K + σ²I, then Cholesky (plain row-major; n is tens at most).
   std::vector<double> k(n * n);
   for (size_t i = 0; i < n; ++i)
@@ -57,8 +48,7 @@ void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
     }
   }
   // alpha = K⁻¹ yn via two triangular solves.
-  std::vector<double> yn(n), tmp(n);
-  for (size_t i = 0; i < n; ++i) yn[i] = (y[i] - y_mean_) / y_std_;
+  std::vector<double> tmp(n);
   for (size_t i = 0; i < n; ++i) {
     double s = yn[i];
     for (size_t m = 0; m < i; ++m) s -= chol_[i * n + m] * tmp[m];
@@ -70,6 +60,46 @@ void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
     for (size_t m = ii + 1; m < n; ++m) s -= chol_[m * n + ii] * alpha_[m];
     alpha_[ii] = s / chol_[ii * n + ii];
   }
+  // lml = -1/2 ynᵀα − Σ log L_ii − n/2 log 2π
+  double lml = 0;
+  for (size_t i = 0; i < n; ++i) lml += yn[i] * alpha_[i];
+  lml *= -0.5;
+  for (size_t i = 0; i < n; ++i) lml -= std::log(chol_[i * n + i]);
+  lml -= 0.5 * n * std::log(2.0 * M_PI);
+  return lml;
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  const size_t n = x.size();
+  x_ = x;
+  y_mean_ = 0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= n;
+  double var = 0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = n > 1 ? std::sqrt(var / n) : 1.0;
+  if (y_std_ == 0) y_std_ = 1.0;
+  std::vector<double> yn(n);
+  for (size_t i = 0; i < n; ++i) yn[i] = (y[i] - y_mean_) / y_std_;
+
+  if (fit_ls_ && n >= 3) {
+    // Type-II MLE over a log grid of length-scales (0.05 → 2.0, 24
+    // points) — dense evaluation instead of the reference's L-BFGS
+    // line search, exact at these sample counts.
+    const int kGrid = 24;
+    double best_ls = ls_, best_lml = -1e300;
+    for (int g = 0; g < kGrid; ++g) {
+      ls_ = 0.05 * std::pow(2.0 / 0.05, g / (kGrid - 1.0));
+      double lml = Factor(x, yn);
+      if (lml > best_lml) {
+        best_lml = lml;
+        best_ls = ls_;
+      }
+    }
+    ls_ = best_ls;
+  }
+  Factor(x, yn);
 }
 
 void GaussianProcess::Predict(const std::vector<double>& x, double* mean,

@@ -17,12 +17,18 @@
 
 namespace hvd {
 
-// GP posterior over f: [0,1]^d -> R, RBF kernel, fixed hyperparameters.
+// GP posterior over f: [0,1]^d -> R, RBF kernel.  The length-scale is
+// selected per Fit by maximum marginal likelihood over a log grid
+// (parity: the reference's L-BFGS MLE, gaussian_process.cc:44+; twin of
+// the python engine's autotune/gaussian_process.py) — pass a positive
+// length_scale to pin it instead.
 class GaussianProcess {
  public:
-  GaussianProcess(double length_scale = 0.25, double signal_variance = 1.0,
+  GaussianProcess(double length_scale = 0.0, double signal_variance = 1.0,
                   double noise_variance = 1e-4)
-      : ls_(length_scale), sv_(signal_variance), nv_(noise_variance) {}
+      : fit_ls_(length_scale <= 0.0),
+        ls_(length_scale <= 0.0 ? 0.25 : length_scale),
+        sv_(signal_variance), nv_(noise_variance) {}
 
   void Fit(const std::vector<std::vector<double>>& x,
            const std::vector<double>& y);
@@ -35,7 +41,15 @@ class GaussianProcess {
  private:
   double Kernel(const std::vector<double>& a,
                 const std::vector<double>& b) const;
+  // Cholesky + weights for the current ls_; returns the log marginal
+  // likelihood (GPML eq. 2.30).  Always finite: a near-non-PD kernel is
+  // clamped (diagonal floored at 1e-12), which naturally scores badly
+  // against better-conditioned candidates rather than needing a
+  // sentinel.
+  double Factor(const std::vector<std::vector<double>>& x,
+                const std::vector<double>& yn);
 
+  bool fit_ls_;
   double ls_, sv_, nv_;
   std::vector<std::vector<double>> x_;
   std::vector<double> alpha_;

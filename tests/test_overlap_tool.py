@@ -44,8 +44,8 @@ ENTRY %main () -> f32[] {
 }
 """
     r = measure(hlo, 8)
-    assert r["async_allreduce_pairs"] == 2
-    assert r["sync_allreduces"] == 0
+    assert r["async_collective_pairs"] == 2
+    assert r["sync_collectives"] == 0
     # ar1 fully hidden by %big (its cost >> ar cost); ar2 has nothing
     # between start and done -> exposed.
     assert r["hidden_s_est"] > 0
@@ -63,8 +63,8 @@ ENTRY %main () -> f32[] {
 }
 """
     r = measure(hlo, 8)
-    assert r["sync_allreduces"] == 1
-    assert r["async_allreduce_pairs"] == 0
+    assert r["sync_collectives"] == 1
+    assert r["async_collective_pairs"] == 0
     # variadic payload counted once (result tuple, not halved)
     expected = 2 * 7 / 8 * (154092 * 4 + 8 * 4) / 4.5e10
     assert abs(r["total_collective_s_est"] - expected) < 1e-12
@@ -111,7 +111,7 @@ ENTRY %main () -> f32[] {
     r = measure(hlo, 8)
     # neither the body's nor the trailing computation's all-reduce may
     # be walked as entry traffic...
-    assert r["sync_allreduces"] == 0
+    assert r["sync_collectives"] == 0
     assert r["total_collective_s_est"] == 0.0
     # ...but both are visible in the diagnostic count.
     assert r["non_entry_collectives"] == 2

@@ -116,19 +116,32 @@ def _wire_factor(base: str, n_dev: int) -> float:
 
 
 def _ring_bytes(rhs: str, op: str) -> int:
-    """Payload bytes of a collective instruction.
+    """Payload bytes N of a collective instruction, where the wire
+    factors above are defined against N = the FULL (unsharded) buffer.
 
-    Prefer the operand shapes (text after the opcode); HLO dumps that
-    print operands as bare ``%names`` fall back to the *result* shape
-    (text before the opcode) — halved for ``-start`` ops, whose result
-    is an (operands, results) alias tuple with the payload twice."""
-    after = rhs.split(op + "(", 1)[-1]
-    b = _shape_bytes(after)
-    if b:
-        return b
-    before = rhs.split(op + "(", 1)[0]
-    b = _shape_bytes(before)
-    return b // 2 if op.endswith("-start") else b
+    all-reduce (incl. variadic): operand shapes sum to N; HLO dumps
+    that print operands as bare ``%names`` fall back to the result
+    shape — halved for ``-start``, whose result is an
+    (operands, results) alias tuple carrying the payload twice.
+
+    all-gather / reduce-scatter / permute / all-to-all: exactly one of
+    input/output is the full buffer (the other is the shard), so N is
+    the LARGEST single shape anywhere on the line — summing would mix
+    shard and full, and the operand-preference rule would undercount
+    all-gather by n_dev (its operand is the shard)."""
+    base, _ = _coll_base(op)
+    if base == "all-reduce":
+        after = rhs.split(op + "(", 1)[-1]
+        b = _shape_bytes(after)
+        if b:
+            return b
+        before = rhs.split(op + "(", 1)[0]
+        b = _shape_bytes(before)
+        return b // 2 if op.endswith("-start") else b
+    best = 0
+    for m in re.finditer(r"\w+\[[\d,]*\]", rhs):
+        best = max(best, _shape_bytes(m.group(0)))
+    return best
 
 
 def _coll_cost(rhs: str, op: str, n_dev: int) -> float:
@@ -150,14 +163,25 @@ def measure(hlo: str, n_dev: int):
     # Bound the entry computation at its closing zero-indent brace —
     # HLO text does not guarantee ENTRY is the last computation, and
     # walking a trailing computation's instructions would contaminate
-    # the schedule simulation.
-    after = hlo.split("ENTRY", 1)[-1]
-    entry_lines = []
-    for ln in after.splitlines():
-        if ln.rstrip() == "}":
+    # the schedule simulation.  Bounds are POSITIONS, not line text:
+    # instruction names are only unique per computation, so a body line
+    # can be byte-identical to an entry line.
+    all_lines = hlo.splitlines()
+    entry_start = entry_end = None
+    for i, ln in enumerate(all_lines):
+        if entry_start is None:
+            if "ENTRY" in ln:
+                entry_start = i
+        elif ln.rstrip() == "}":
+            entry_end = i
             break
-        entry_lines.append(ln)
-    lines = [ln.strip() for ln in entry_lines if "=" in ln]
+    if entry_start is None:
+        entry_start = 0
+        entry_end = len(all_lines)
+    elif entry_end is None:
+        entry_end = len(all_lines)
+    lines = [ln.strip()
+             for ln in all_lines[entry_start:entry_end] if "=" in ln]
     in_flight: dict = {}   # start-instruction name -> remaining seconds
     total_coll = hidden = 0.0
     async_pairs = sync_ars = 0
@@ -199,18 +223,19 @@ def measure(hlo: str, n_dev: int):
     # loop body reads as "incomplete" rather than silently measuring
     # only part of the traffic.
     non_entry = 0
-    entry_set = set(lines)
-    for ln in hlo.splitlines():
+    for i, ln in enumerate(all_lines):
+        if entry_start <= i < entry_end:
+            continue
         s = ln.strip()
-        if "=" in s and s not in entry_set:
+        if "=" in s:
             op = _opcode(s.split("=", 1)[1])
             if op:
                 base, kind = _coll_base(op)
                 if base in _COLLECTIVE_BASES and kind != "-done":
                     non_entry += 1
     return {
-        "async_allreduce_pairs": async_pairs,
-        "sync_allreduces": sync_ars,
+        "async_collective_pairs": async_pairs,
+        "sync_collectives": sync_ars,
         "non_entry_collectives": non_entry,
         "total_collective_s_est": total_coll,
         "hidden_s_est": hidden,
@@ -308,7 +333,7 @@ def main() -> None:
     hlo = compiled.as_text()
     result = {"model": args.model, "platform": platform, "n_dev": n,
               **measure(hlo, n)}
-    if not result["async_allreduce_pairs"] and platform != "tpu":
+    if not result["async_collective_pairs"] and platform != "tpu":
         result["note"] = ("no async collective pairs in this platform's "
                           "schedule (CPU collectives are synchronous); "
                           "run on TPU for the real number")
