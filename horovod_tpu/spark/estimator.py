@@ -264,16 +264,24 @@ class TorchEstimator(HorovodEstimator):
     """
 
     def __init__(self, model, optimizer=None, loss=None,
-                 classification=None, **kw):
+                 classification=None, metrics=(), **kw):
         """``classification``: force (True/False) the index-target
         coercion for single-column labels; default None auto-detects
         CrossEntropyLoss/NLLLoss instances — pass True for functional
-        or custom index-target losses."""
+        or custom index-target losses.
+
+        ``metrics`` (parity: common/params.py:32 + torch/remote.py
+        metric aggregation): callables ``(pred, target) -> scalar
+        tensor``, evaluated per epoch on train and validation data and
+        cross-rank averaged; results land in the fitted model's
+        ``metrics_history[name]`` / ``val_metrics_history[name]``
+        (``name`` = the callable's ``__name__``)."""
         super().__init__(**kw)
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
         self.classification = classification
+        self.metrics = list(metrics)
 
     def fit(self, df) -> "TorchModel":
         import torch
@@ -293,6 +301,16 @@ class TorchEstimator(HorovodEstimator):
         batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
         classification = self.classification
         has_validation = self.validation is not None
+        metric_fns = list(self.metrics)
+        metric_names = []
+        for i, m in enumerate(metric_fns):
+            base = getattr(m, "__name__", "") or f"metric{i}"
+            name = base
+            k = 2
+            while name in metric_names:  # two lambdas must not merge
+                name = f"{base}_{k}"
+                k += 1
+            metric_names.append(name)
 
         def build(run_id):
             def _train():
@@ -329,9 +347,16 @@ class TorchEstimator(HorovodEstimator):
                 # epoch loop).  Rank 0 reads; broadcast aligns everyone.
                 import io as _io
 
+                def _pad(seq, upto):
+                    # History lists always index by epoch; epochs that
+                    # ran before a knob was enabled read as None.
+                    return [None] * (upto - len(seq)) + list(seq)
+
                 start_epoch = 0
                 history = []
                 val_history = []
+                metrics_history = {n: [] for n in metric_names}
+                val_metrics_history = {n: [] for n in metric_names}
                 ck = store.latest_checkpoint(run_id) if rank == 0 else None
                 flag = hvd.broadcast_object(
                     ck[0] if ck else None, root_rank=0,
@@ -345,17 +370,57 @@ class TorchEstimator(HorovodEstimator):
                         dist_opt.load_state_dict(st["optimizer"])
                         history = list(st.get("history", []))
                         val_history = list(st.get("val_history", []))
+                        # Only metrics still configured survive resume;
+                        # stale keys would stop indexing by epoch.
+                        for n in metric_names:
+                            if n in st.get("metrics_history", {}):
+                                metrics_history[n] = list(
+                                    st["metrics_history"][n])
+                            if n in st.get("val_metrics_history", {}):
+                                val_metrics_history[n] = list(
+                                    st["val_metrics_history"][n])
                     start_epoch = int(flag) + 1
-                    history, val_history = hvd.broadcast_object(
-                        (history, val_history), root_rank=0,
+                    (history, val_history, metrics_history,
+                     val_metrics_history) = hvd.broadcast_object(
+                        (history, val_history, metrics_history,
+                         val_metrics_history), root_rank=0,
                         name="est.resume.hist")
-                    if Xv is not None and len(val_history) < start_epoch:
-                        # Validation newly enabled on an old run: pad so
-                        # val_history[i] always refers to epoch i (None
-                        # = epoch ran without validation).
-                        val_history = ([None] * (start_epoch
-                                                 - len(val_history))
-                                       + val_history)
+                    if Xv is not None:
+                        val_history = _pad(val_history, start_epoch)
+                    for n in metric_names:
+                        metrics_history[n] = _pad(
+                            metrics_history.get(n, []), start_epoch)
+                        if Xv is not None:
+                            val_metrics_history[n] = _pad(
+                                val_metrics_history.get(n, []),
+                                start_epoch)
+
+                def _eval_split(Xa, ya, tag, epoch, named_fns):
+                    """Cross-rank-averaged values of ``(name, fn)``
+                    pairs over a split: one forward per batch shared by
+                    every fn, eval mode, a single sum+count allreduce."""
+                    names = [n for n, _ in named_fns]
+                    sums = {n: 0.0 for n in names}
+                    count = 0
+                    local.eval()
+                    with torch.no_grad():
+                        for i in range(0, len(Xa), batch_size):
+                            xb = torch.from_numpy(Xa[i:i + batch_size])
+                            yb = torch.from_numpy(ya[i:i + batch_size])
+                            if classify:
+                                yb = yb.reshape(-1).long()
+                            pred = local(xb)
+                            for n, fn in named_fns:
+                                sums[n] += float(fn(pred, yb)) * len(xb)
+                            count += len(xb)
+                    local.train()
+                    flat = [sums[n] for n in names] + [float(count)]
+                    agg = hvd.allreduce(
+                        torch.tensor(flat, dtype=torch.float64),
+                        op=hvd.Sum, name=f"est.metrics.{tag}.{epoch}")
+                    total = max(float(agg[-1]), 1.0)
+                    return {n: float(agg[i]) / total
+                            for i, n in enumerate(names)}
                 # Optimizer state FIRST: on a fresh optimizer its
                 # broadcast initializes state via a root-only zero-grad
                 # step, which can move root's params (e.g. AdamW's
@@ -387,37 +452,35 @@ class TorchEstimator(HorovodEstimator):
                         torch.tensor([total / max(nb, 1)]),
                         op=hvd.Average, name=f"est.loss.{_epoch}")[0])
                     history.append(avg)
+                    metric_pairs = list(zip(metric_names, metric_fns))
                     if Xv is not None:
-                        # eval mode (frozen BN stats, no dropout) and
-                        # the training batch size — a whole-shard
-                        # forward would peak memory far above training.
-                        # Sum+count allreduce: exact mean under uneven
-                        # per-rank validation rows.
-                        local.eval()
-                        vtotal, vn = 0.0, 0
-                        with torch.no_grad():
-                            for i in range(0, len(Xv), batch_size):
-                                xb = torch.from_numpy(
-                                    Xv[i:i + batch_size])
-                                yb = torch.from_numpy(
-                                    yv[i:i + batch_size])
-                                if classify:
-                                    yb = yb.reshape(-1).long()
-                                vtotal += float(
-                                    loss_fn(local(xb), yb)) * len(xb)
-                                vn += len(xb)
-                        local.train()
-                        agg = hvd.allreduce(
-                            torch.tensor([vtotal, float(vn)]),
-                            op=hvd.Sum, name=f"est.vloss.{_epoch}")
-                        val_history.append(
-                            float(agg[0]) / max(float(agg[1]), 1.0))
+                        # One eval pass over the validation shard covers
+                        # the loss AND every metric (shared forwards;
+                        # eval mode = frozen BN stats, no dropout;
+                        # batched so peak memory matches training;
+                        # sum+count allreduce = exact mean under uneven
+                        # per-rank rows).
+                        v = _eval_split(Xv, yv, "v", _epoch,
+                                        [("__loss__", loss_fn)]
+                                        + metric_pairs)
+                        val_history.append(v["__loss__"])
+                        for n in metric_names:
+                            val_metrics_history[n].append(v[n])
+                    if metric_pairs:
+                        tr_m = _eval_split(X, y, "t", _epoch,
+                                           metric_pairs)
+                        for n in metric_names:
+                            metrics_history[n].append(tr_m[n])
                     if rank == 0:
                         buf = _io.BytesIO()
-                        torch.save({"model": local.state_dict(),
-                                    "optimizer": dist_opt.state_dict(),
-                                    "history": history,
-                                    "val_history": val_history}, buf)
+                        torch.save(
+                            {"model": local.state_dict(),
+                             "optimizer": dist_opt.state_dict(),
+                             "history": history,
+                             "val_history": val_history,
+                             "metrics_history": metrics_history,
+                             "val_metrics_history": val_metrics_history},
+                            buf)
                         store.save_checkpoint(run_id, _epoch,
                                               buf.getvalue())
                 if rank == 0:
@@ -429,7 +492,9 @@ class TorchEstimator(HorovodEstimator):
                         k: v.detach().cpu().numpy()
                         for k, v in local.state_dict().items()},
                         "history": history,
-                        "val_history": val_history}
+                        "val_history": val_history,
+                        "metrics_history": metrics_history,
+                        "val_metrics_history": val_metrics_history}
                 return None
 
             return _train
@@ -439,10 +504,12 @@ class TorchEstimator(HorovodEstimator):
         fitted.load_state_dict(
             {k: __import__("torch").from_numpy(np.asarray(v))
              for k, v in arts["state_dict"].items()})
-        return TorchModel(fitted, self.feature_cols, self.label_cols,
-                          history=arts["history"],
-                          run_id=self._last_run_id,
-                          val_history=arts.get("val_history"))
+        return TorchModel(
+            fitted, self.feature_cols, self.label_cols,
+            history=arts["history"], run_id=self._last_run_id,
+            val_history=arts.get("val_history"),
+            metrics_history=arts.get("metrics_history"),
+            val_metrics_history=arts.get("val_metrics_history"))
 
 
 class _FittedModel:
@@ -451,13 +518,16 @@ class _FittedModel:
     DataFrames)."""
 
     def __init__(self, model, feature_cols, label_cols, history=None,
-                 run_id=None, val_history=None):
+                 run_id=None, val_history=None, metrics_history=None,
+                 val_metrics_history=None):
         self._model = model
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
         self.history = history
         self.run_id = run_id
         self.val_history = list(val_history or [])
+        self.metrics_history = dict(metrics_history or {})
+        self.val_metrics_history = dict(val_metrics_history or {})
 
     def getModel(self):
         return self._model

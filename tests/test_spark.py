@@ -402,3 +402,35 @@ def test_keras_estimator_custom_objects(tmp_path):
     fitted = est.fit(df)
     assert fitted.history["loss"][-1] < fitted.history["loss"][0]
     assert any(isinstance(l, Scale2) for l in fitted.getModel().layers)
+
+
+def test_torch_estimator_metrics_history(tmp_path):
+    """metrics=[fn] parity (reference common/params.py:32): per-epoch
+    cross-rank-averaged metric values on train and validation splits."""
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalBackend, TorchEstimator
+    from horovod_tpu.spark.store import Store
+
+    def mae(pred, target):
+        return (pred - target).abs().mean()
+
+    df, X, y = _teacher_frame()
+    model = torch.nn.Linear(6, 1)
+    est = TorchEstimator(
+        model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.05),
+        loss=torch.nn.MSELoss(),
+        metrics=[mae],
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=3, num_proc=2,
+        store=Store.create(str(tmp_path)),
+        backend=LocalBackend(2), validation=0.25)
+    fitted = est.fit(df)
+    assert list(fitted.metrics_history) == ["mae"]
+    assert len(fitted.metrics_history["mae"]) == 3
+    assert len(fitted.val_metrics_history["mae"]) == 3
+    # the teacher task: MAE falls on both splits
+    assert fitted.metrics_history["mae"][-1] < \
+        fitted.metrics_history["mae"][0]
+    assert fitted.val_metrics_history["mae"][-1] < \
+        fitted.val_metrics_history["mae"][0]
