@@ -549,6 +549,30 @@ class TorchModel(_FittedModel):
             return self._model(
                 torch.from_numpy(np.asarray(X, np.float32))).numpy()
 
+    @classmethod
+    def load(cls, store, run_id: str, model,
+             feature_cols=("features",), label_cols=("label",)
+             ) -> "TorchModel":
+        """Rebuild a fitted model from the store's final artifact
+        (parity: the reference estimator's model-back-from-store
+        serialization, spark/torch/estimator.py).  ``model``: a module
+        with the fitted architecture; its state is loaded from
+        ``<run_path>/checkpoint.pt``."""
+        import copy as _copy
+        import io as _io
+
+        import torch
+
+        payload = store.read_bytes(store.checkpoint_path(run_id) + ".pt")
+        fitted = _copy.deepcopy(model)
+        # weights_only: the artifact is a plain tensor state_dict, and
+        # a remote store is attacker-writable territory — full pickle
+        # would mean arbitrary code execution on load.
+        fitted.load_state_dict(
+            torch.load(_io.BytesIO(payload), map_location="cpu",
+                       weights_only=True))
+        return cls(fitted, feature_cols, label_cols, run_id=run_id)
+
 
 # ---------------------------------------------------------------------------
 # keras
@@ -748,3 +772,37 @@ class KerasModel(_FittedModel):
     def predict(self, X: np.ndarray) -> np.ndarray:
         return np.asarray(self._model.predict(
             np.asarray(X, np.float32), verbose=0))
+
+    @classmethod
+    def load(cls, store, run_id: str, custom_objects=None,
+             feature_cols=("features",), label_cols=("label",)
+             ) -> "KerasModel":
+        """Rebuild a fitted model from the store's ``checkpoint.keras``
+        archive (parity: keras/estimator.py:521 model-back-from-store +
+        keras/__init__.py load_model).  Works against remote (fsspec)
+        stores — the archive bytes are staged through a temp file
+        because keras archives need a real filesystem path."""
+        import tempfile
+
+        import keras
+
+        payload = store.read_bytes(
+            store.checkpoint_path(run_id) + ".keras")
+        tmp_name = None
+        try:
+            with tempfile.NamedTemporaryFile(suffix=".keras",
+                                             delete=False) as tf:
+                tmp_name = tf.name
+                tf.write(payload)
+            # compile=False: the archive's optimizer is the runtime
+            # DistributedOptimizer wrapper, which only exists inside an
+            # hvd worker; this loader serves inference/transform (for
+            # retraining with the wrapped optimizer, use
+            # horovod_tpu.keras.load_model).
+            with keras.saving.custom_object_scope(custom_objects or {}):
+                fitted = keras.models.load_model(tmp_name,
+                                                 compile=False)
+        finally:
+            if tmp_name is not None:
+                os.unlink(tmp_name)
+        return cls(fitted, feature_cols, label_cols, run_id=run_id)

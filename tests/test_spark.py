@@ -434,3 +434,49 @@ def test_torch_estimator_metrics_history(tmp_path):
         fitted.metrics_history["mae"][0]
     assert fitted.val_metrics_history["mae"][-1] < \
         fitted.val_metrics_history["mae"][0]
+
+
+def test_fitted_models_load_from_store(tmp_path):
+    """Model-back-from-store round trip (reference estimator
+    serialization): TorchModel.load / KerasModel.load rebuild the
+    fitted model from the store artifact and predict identically."""
+    torch = pytest.importorskip("torch")
+    keras = pytest.importorskip("keras")
+    from horovod_tpu.spark import (
+        KerasEstimator,
+        KerasModel,
+        LocalBackend,
+        TorchEstimator,
+        TorchModel,
+    )
+    from horovod_tpu.spark.store import Store
+
+    store = Store.create(str(tmp_path))
+    df, X, y = _teacher_frame(96, 4)
+
+    tmodel = torch.nn.Linear(4, 1)
+    tfit = TorchEstimator(
+        tmodel, loss=torch.nn.MSELoss(),
+        optimizer=torch.optim.SGD(tmodel.parameters(), lr=0.05),
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=2, num_proc=2, store=store,
+        backend=LocalBackend(2), run_id="tload").fit(df)
+    tloaded = TorchModel.load(store, "tload", torch.nn.Linear(4, 1),
+                              feature_cols=["features"],
+                              label_cols=["label"])
+    np.testing.assert_allclose(tloaded.predict(X), tfit.predict(X),
+                               rtol=1e-6)
+
+    keras.utils.set_random_seed(0)
+    kmodel = keras.Sequential([keras.layers.Input((4,)),
+                               keras.layers.Dense(1)])
+    kfit = KerasEstimator(
+        kmodel, loss="mse",
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=2, num_proc=2, store=store,
+        backend=LocalBackend(2), run_id="kload").fit(df)
+    kloaded = KerasModel.load(store, "kload",
+                              feature_cols=["features"],
+                              label_cols=["label"])
+    np.testing.assert_allclose(kloaded.predict(X), kfit.predict(X),
+                               rtol=1e-5, atol=1e-6)
