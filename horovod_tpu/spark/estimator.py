@@ -45,6 +45,41 @@ def _to_pandas(df):
     return pd.DataFrame({k: list(np.asarray(v)) for k, v in df.items()})
 
 
+def _df_digest(pdf, num_shards: int, validation, seed: int) -> str:
+    """Content digest of a materialization request (parity:
+    spark/common/cache.py TrainingDataCache — repeated fits over the
+    same data skip the Petastorm re-write there; the parquet re-write
+    here).  Hashes the raw column bytes: equal bytes = equal shards."""
+    import hashlib
+
+    m = hashlib.sha1()
+    m.update(repr((sorted(map(str, pdf.columns)), num_shards,
+                   validation, seed)).encode())
+    for c in sorted(map(str, pdf.columns)):
+        col = pdf[c].to_numpy()
+        # dtype + length prefix: byte-identical buffers of different
+        # dtypes (int32 [1,2] vs int64 [big]) must not collide.
+        m.update(f"{c}:{col.dtype.str}:{len(col)}:".encode())
+        if col.dtype == object:
+            # NEVER col.tobytes() on object arrays — that serializes
+            # heap POINTERS (no error raised), so equal values hash
+            # differently and, worse, recycled addresses can collide.
+            hashed = False
+            if len(col) and isinstance(col[0], (list, np.ndarray)):
+                try:
+                    arr = np.stack([np.asarray(v) for v in col])
+                    m.update(f"{arr.dtype.str}:{arr.shape}:".encode())
+                    m.update(np.ascontiguousarray(arr).tobytes())
+                    hashed = True
+                except (TypeError, ValueError):
+                    pass
+            if not hashed:
+                m.update(repr(col.tolist()).encode())
+        else:
+            m.update(np.ascontiguousarray(col).tobytes())
+    return m.hexdigest()
+
+
 def _write_shards(pdf, store: Store, path: str, num_shards: int) -> None:
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -73,8 +108,20 @@ def materialize(df, store: Store, run_id: str, num_shards: int,
     a string names an indicator column — truthy rows become the
     validation set and the column is dropped from both splits.
     Validation shards land in ``store.val_data_path(run_id)``.
+
+    Repeated fits over byte-identical data under the same ``run_id``
+    skip the re-write entirely (a content digest is stored alongside
+    the shards; parity: spark/common/cache.py TrainingDataCache).
     """
     pdf = _to_pandas(df)
+    digest = _df_digest(pdf, num_shards, validation, seed)
+    digest_path = store.join(store.train_data_path(run_id), "_digest")
+    try:
+        prev = store.read_bytes(digest_path).decode().splitlines()
+        if prev and prev[0] == digest:
+            return int(prev[1])
+    except Exception:
+        pass  # absent/corrupt digest -> materialize fresh
     val_pdf = None
     if validation is not None:
         if isinstance(validation, str):
@@ -104,6 +151,10 @@ def materialize(df, store: Store, run_id: str, num_shards: int,
     if val_pdf is not None:
         _write_shards(val_pdf, store, store.val_data_path(run_id),
                       num_shards)
+    # Digest written LAST: a partial materialization can never pass as
+    # cached on the next fit.
+    store.write_bytes(digest_path,
+                      f"{digest}\n{len(pdf)}\n".encode())
     return len(pdf)
 
 

@@ -480,3 +480,35 @@ def test_fitted_models_load_from_store(tmp_path):
                               label_cols=["label"])
     np.testing.assert_allclose(kloaded.predict(X), kfit.predict(X),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_materialize_skips_rewrite_for_identical_data(tmp_path):
+    """Prepared-data cache (reference spark/common/cache.py): a second
+    materialize over byte-identical data must not rewrite the shards;
+    changed data must."""
+    import os
+    import time
+
+    from horovod_tpu.spark.estimator import materialize
+    from horovod_tpu.spark.store import Store
+
+    df, X, y = _teacher_frame(64, 4)
+    store = Store.create(str(tmp_path))
+    n1 = materialize(df, store, "rc", 2)
+    shard = store.shard_paths("rc")[0]
+    mtime = os.path.getmtime(shard)
+    time.sleep(0.05)
+    n2 = materialize(df.copy(), store, "rc", 2)
+    assert n2 == n1 == 64
+    assert os.path.getmtime(shard) == mtime, "identical data rewrote"
+
+    df2 = df.copy()
+    df2["label"] = df2["label"] * 2
+    n3 = materialize(df2, store, "rc", 2)
+    assert n3 == 64
+    assert os.path.getmtime(store.shard_paths("rc")[0]) != mtime, \
+        "changed data did not rewrite"
+
+    # different shard count must also re-materialize
+    materialize(df, store, "rc", 4)
+    assert len(store.shard_paths("rc")) == 4
