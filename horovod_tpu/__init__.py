@@ -55,7 +55,7 @@ from horovod_tpu.basics import (  # noqa: F401
     size,
     xla_built,
 )
-from horovod_tpu.common.types import ReduceOp  # noqa: F401
+from horovod_tpu.common.types import RanksFailedError, ReduceOp  # noqa: F401
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.process_sets import ProcessSet  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401

@@ -20,6 +20,7 @@ import struct
 import threading
 from typing import Dict, Optional, Tuple
 
+from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 
@@ -39,6 +40,7 @@ def bootstrap_mesh(
     """
     from horovod_tpu.runner.http_client import KVClient
 
+    _fi.fire("bootstrap.start", str(rank))
     # Launcher-provided startup budget (hvdrun --start-timeout);
     # parity: HOROVOD_GLOO_TIMEOUT_SECONDS (gloo_context.cc:38-40).
     start_timeout = env_util.get_float("HVD_START_TIMEOUT", 120.0)
@@ -88,6 +90,7 @@ def bootstrap_mesh(
     def _accept_loop():
         for _ in range(n_accept):
             s, _addr = listener.accept()
+            _fi.fire("bootstrap.accept", str(rank))
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             hdr = su.recv_exact(s, 8)
             peer_rank, chan = struct.unpack("<ii", hdr)

@@ -1,0 +1,147 @@
+"""Deterministic fault injection for chaos testing.
+
+A process-global *fault plan* names injection **sites** threaded through
+the control plane (socket helpers, rendezvous KV client/server, bootstrap,
+the PyEngine loops) and describes what to do when execution passes one:
+drop the operation (raise), delay it, raise an arbitrary error, or kill
+the process outright.  Faults can be one-shot (``times`` / ``after``) or
+probabilistic (``prob`` under a fixed ``seed``) — both deterministic, so
+multi-process chaos scenarios replay exactly.
+
+The plan comes from the ``HOROVOD_FAULT_PLAN`` environment variable
+(inline JSON, or a path to a JSON file) or from :func:`configure`.  With
+no plan set, every :func:`fire` call is a single module-global ``None``
+check — no allocation, no locking, no time lookup — so production code
+pays nothing for carrying the hooks (pinned by tests/test_chaos.py).
+
+Plan format::
+
+    {"seed": 123, "faults": [
+        {"site": "kv.put", "kind": "error", "times": 3},
+        {"site": "sock.connect", "kind": "delay", "delay_s": 0.2,
+         "prob": 0.5},
+        {"site": "train.step", "kind": "kill", "after": 2},
+        {"site": "ctrl.worker.send", "kind": "drop", "match": "req"}
+    ]}
+
+Fault fields:
+
+* ``site``   — exact injection-site name (required).
+* ``kind``   — ``drop`` | ``error`` (both raise :class:`InjectedFault`,
+  a ``ConnectionError`` so existing network error handling engages),
+  ``delay`` (sleep ``delay_s``), ``kill`` (``os._exit(137)``, the
+  SIGKILL-style death a supervisor sees).
+* ``match``  — substring that must appear in the call's ``detail``.
+* ``times``  — fire at most this many times (default: unlimited).
+* ``after``  — skip the first N matching passes (default 0).
+* ``prob``   — fire with this probability, drawn from a PRNG seeded by
+  the plan ``seed`` (default: always fire).
+* ``delay_s``— sleep duration for ``kind: delay`` (default 0.1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import List, Optional
+
+ENV_VAR = "HOROVOD_FAULT_PLAN"
+
+
+class InjectedFault(ConnectionError):
+    """An artificial failure raised at a fault-injection site."""
+
+
+class _Fault:
+    __slots__ = ("site", "kind", "match", "times", "after", "prob",
+                 "delay_s", "hits", "fired")
+
+    def __init__(self, spec: dict):
+        self.site = spec["site"]
+        self.kind = spec.get("kind", "error")
+        if self.kind not in ("drop", "error", "delay", "kill"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        self.match = spec.get("match")
+        self.times = spec.get("times")
+        self.after = int(spec.get("after", 0))
+        self.prob = spec.get("prob")
+        self.delay_s = float(spec.get("delay_s", 0.1))
+        self.hits = 0    # matching passes seen
+        self.fired = 0   # faults actually injected
+
+
+class _Plan:
+    def __init__(self, spec: dict):
+        self.faults: List[_Fault] = [
+            _Fault(f) for f in spec.get("faults", [])]
+        self.rng = random.Random(spec.get("seed", 0))
+        self.lock = threading.Lock()
+
+
+# None = fault injection disabled; the single hot-path flag.
+_PLAN: Optional[_Plan] = None
+
+
+def fire(site: str, detail: str = "") -> None:
+    """Injection-site hook.  No-op (one global load + ``is`` check) unless
+    a fault plan is active and names ``site``."""
+    plan = _PLAN
+    if plan is None:
+        return
+    _fire_slow(plan, site, detail)
+
+
+def _fire_slow(plan: _Plan, site: str, detail: str) -> None:
+    for f in plan.faults:
+        if f.site != site:
+            continue
+        if f.match is not None and f.match not in detail:
+            continue
+        with plan.lock:
+            f.hits += 1
+            if f.hits <= f.after:
+                continue
+            if f.times is not None and f.fired >= f.times:
+                continue
+            if f.prob is not None and plan.rng.random() >= f.prob:
+                continue
+            f.fired += 1
+        if f.kind == "delay":
+            time.sleep(f.delay_s)
+            continue
+        if f.kind == "kill":
+            os._exit(137)
+        raise InjectedFault(
+            f"injected {f.kind} at {site!r}"
+            + (f" ({detail})" if detail else ""))
+
+
+def configure(spec: Optional[dict]) -> None:
+    """Install a fault plan programmatically (``None`` clears it)."""
+    global _PLAN
+    _PLAN = _Plan(spec) if spec else None
+
+
+def clear() -> None:
+    configure(None)
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def _load_from_env() -> None:
+    raw = os.environ.get(ENV_VAR)
+    if not raw:
+        return
+    raw = raw.strip()
+    if not raw.startswith("{"):
+        with open(raw) as fh:
+            raw = fh.read()
+    configure(json.loads(raw))
+
+
+_load_from_env()

@@ -123,6 +123,28 @@ class ResponseType(enum.IntEnum):
     BARRIER = 5
     REDUCESCATTER = 6
     ERROR = 7
+    # Coordinator liveness extension (PyEngine only, gated behind
+    # HVD_HEARTBEAT_TIMEOUT > 0): announces dead-rank eviction.  The
+    # evicted global ranks ride ``tensor_sizes`` — the existing Response
+    # wire layout carries it unchanged, so csrc/wire.h stays in sync.
+    EVICT = 8
+
+
+class RanksFailedError(RuntimeError):
+    """Raised by the enqueue API after the coordinator evicted dead ranks.
+
+    In-flight collectives complete on the survivors (zero stand-ins via
+    the Join machinery); the *next* submitted op raises this so the
+    training loop can checkpoint and exit for a ``--max-restarts``
+    relaunch."""
+
+    def __init__(self, ranks):
+        self.ranks = sorted(int(r) for r in ranks)
+        super().__init__(
+            f"rank(s) {self.ranks} stopped responding and were evicted; "
+            f"surviving ranks completed in-flight collectives — "
+            f"checkpoint and restart (hvdrun --max-restarts relaunches "
+            f"automatically)")
 
 
 class StatusType(enum.IntEnum):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from horovod_tpu.ops.collective import _one_axis_size
+
 
 def adasum_pair(a, b, dot, anorm_sq, bnorm_sq):
     """Combine two gradients given precomputed <a,b>, ‖a‖², ‖b‖².
@@ -94,7 +96,7 @@ def adasum_allreduce(x, axis: Union[str, Sequence[str]] = "dp"):
 
 
 def _adasum_one_axis(x, axis: str):
-    n = lax.axis_size(axis)
+    n = _one_axis_size(axis)
     if n == 1:
         return x
     assert n & (n - 1) == 0, "adasum requires power-of-two axis size"

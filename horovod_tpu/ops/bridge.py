@@ -117,15 +117,32 @@ def _check_single_device_trace(*operands) -> None:
         if probe():
             raise TypeError(_MISUSE_MSG)
         return
-    # Probe API gone: fall back to operand-trace inspection.  A concrete
-    # (non-tracer) operand positively proves there is no surrounding
-    # trace, and a plain-jit tracer is equally conclusive — only the
-    # zero-operand path (barrier) leaves the guard blind.
+    # Probe API gone: read the axis env directly (what the probe wraps).
+    # Modern pmap traces through the ordinary jaxpr machinery, so the
+    # operand tracers below cannot tell it apart from plain jit — the
+    # axis env is the only reliable signal for it.
+    try:
+        from jax._src.core import get_axis_env
+
+        if get_axis_env().axis_sizes:
+            raise TypeError(_MISUSE_MSG)
+        return
+    except (ImportError, AttributeError):
+        pass
+    # Last resort: operand-trace inspection.  A concrete (non-tracer)
+    # operand positively proves there is no surrounding trace, and a
+    # plain-jit tracer is equally conclusive — only the zero-operand
+    # path (barrier) leaves the guard blind.
     for x in operands:
         if isinstance(x, jax.core.Tracer):
             tr = type(getattr(x, "_trace", None))
             label = f"{tr.__module__}.{tr.__name__}".lower()
-            if "shard_map" in label or "pmap" in label:
+            # pmap tracers live in jax's pxla/batching machinery
+            # (MapTracer / pxla module names) rather than a module
+            # spelled "pmap" — match those too, or pmap misuse would
+            # hang instead of raising on probe-less jax versions.
+            if ("shard_map" in label or "pmap" in label
+                    or "pxla" in label or "maptracer" in label):
                 raise TypeError(_MISUSE_MSG)
     if not operands:
         # Nothing to inspect: the guard is blind on this jax version —

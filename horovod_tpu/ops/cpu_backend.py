@@ -214,12 +214,20 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
 
 def resp_group(engine, resp: Response):
     """(member global ranks, my index) for a response — the full world
-    for the global set, the registered member list for a process set."""
+    for the global set, the registered member list for a process set.
+
+    Ranks the coordinator evicted (heartbeat liveness, PyEngine only)
+    drop out of the global group: every survivor filters identically, so
+    the ring stays coherent without the dead peer."""
     if resp.process_set_id:
         from horovod_tpu import process_sets
 
         members = process_sets.ranks_of(resp.process_set_id)
         return members, members.index(engine.rank)
+    evicted = getattr(engine, "_evicted_ranks", None)
+    if evicted:
+        group = [r for r in range(engine.size) if r not in evicted]
+        return group, group.index(engine.rank)
     return list(range(engine.size)), engine.rank
 
 
@@ -239,9 +247,11 @@ class _AllreduceCandidate:
 class AdasumAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
         # Adasum's distance-doubling assumes the global power-of-two
-        # topology; process sets fall through to the ring.
+        # topology; process sets (and a post-eviction shrunken group)
+        # fall through to the ring.
         return resp.reduce_op == ReduceOp.ADASUM \
-            and not resp.process_set_id
+            and not resp.process_set_id \
+            and not getattr(engine, "_evicted_ranks", None)
 
     def execute(self, engine, flat, op, group, me):
         return _adasum_flat(engine, flat)
@@ -251,6 +261,7 @@ class HierarchicalAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
         return (resp.reduce_op != ReduceOp.ADASUM
                 and not resp.process_set_id
+                and not getattr(engine, "_evicted_ranks", None)
                 and getattr(engine, "hierarchical_allreduce", False)
                 and engine.hierarchical_topology_ok())
 
@@ -371,6 +382,7 @@ def _allgather_hierarchical(engine, entries, resp: Response):
 class HierarchicalAllgather:
     def enabled(self, engine, resp):
         return (not resp.process_set_id
+                and not getattr(engine, "_evicted_ranks", None)
                 and getattr(engine, "hierarchical_allgather", False)
                 and engine.hierarchical_topology_ok())
 
@@ -404,6 +416,10 @@ def _allgather_flat(engine, entries, resp: Response):
     results = []
     for e in entries:
         first_dims = resp.tensor_sizes
+        if not resp.process_set_id and len(first_dims) != size:
+            # Global-set sizes are negotiated in world-rank order; after
+            # an eviction the group is smaller — keep the members' slots.
+            first_dims = [first_dims[r] for r in group]
         rest_shape = e.array.shape[1:] if e.array.ndim > 0 else ()
         dtype = _np_dtype(resp.tensor_type)
         blocks: List[Optional[np.ndarray]] = [None] * size

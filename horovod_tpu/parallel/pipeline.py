@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+
+from horovod_tpu.ops.collective import _one_axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import transformer as tfm
@@ -71,7 +73,7 @@ def gpipe(stage_fn, x_mb, *, axis: str = "pp"):
     stage's cell.  Returns ``([M, ...] outputs, total_aux)``, both
     replicated across the ``axis`` ring.
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = _one_axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = x_mb.shape[0]
     ticks = n_micro + n_stages - 1

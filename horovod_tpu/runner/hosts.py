@@ -11,8 +11,11 @@ the DCN axis across slices).
 from __future__ import annotations
 
 import re
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
+
+from horovod_tpu.utils import env as env_util
 
 
 @dataclass(frozen=True)
@@ -72,6 +75,51 @@ def parse_hostfile(path: str) -> List[HostSlots]:
     if not out:
         raise ValueError(f"no hosts found in hostfile {path}")
     return out
+
+
+class HostBlacklist:
+    """Failure tracking for the relaunch loop (``hvdrun --max-restarts``).
+
+    A host whose workers died ``threshold`` times inside the cool-down
+    window is *blacklisted*: skipped on the next allocation while the
+    remaining hosts still cover ``np`` slots.  Failures age out after
+    ``cooldown_s`` — a flaky host is re-probed instead of banned forever
+    (parity concept: Elastic Horovod's host blacklist + whitelist decay,
+    ``run/elastic/discovery.py``).
+    """
+
+    def __init__(self, threshold: int = None, cooldown_s: float = None):
+        self.threshold = threshold if threshold is not None else \
+            env_util.get_int(env_util.BLACKLIST_THRESHOLD, 2)
+        self.cooldown_s = cooldown_s if cooldown_s is not None else \
+            env_util.get_float(env_util.BLACKLIST_COOLDOWN_S, 300.0)
+        self._failures: Dict[str, List[float]] = {}
+
+    def record_failure(self, hostname: str, now: float = None) -> None:
+        if not hostname:
+            return
+        self._failures.setdefault(hostname, []).append(
+            time.monotonic() if now is None else now)
+
+    def failure_count(self, hostname: str, now: float = None) -> int:
+        now = time.monotonic() if now is None else now
+        recent = [t for t in self._failures.get(hostname, ())
+                  if now - t <= self.cooldown_s]
+        self._failures[hostname] = recent
+        return len(recent)
+
+    def is_blacklisted(self, hostname: str, now: float = None) -> bool:
+        return self.failure_count(hostname, now) >= self.threshold
+
+    def filter_hosts(self, hosts: List[HostSlots],
+                     np: int) -> List[HostSlots]:
+        """``hosts`` minus blacklisted entries — unless that leaves fewer
+        than ``np`` slots, in which case the full list comes back (a
+        degraded host is better than no relaunch at all)."""
+        keep = [h for h in hosts if not self.is_blacklisted(h.hostname)]
+        if keep and sum(h.slots for h in keep) >= np:
+            return keep
+        return hosts
 
 
 def allocate(hosts: List[HostSlots], np: int) -> List[SlotInfo]:

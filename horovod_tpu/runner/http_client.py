@@ -6,6 +6,13 @@ job secret (``run/common/util/secret.py`` pattern).  The secret defaults
 to the ``HVD_SECRET_KEY`` environment variable — the channel the launcher
 ships it to workers on — so every existing call site signs automatically
 when a secret is in play.
+
+Every request retries with capped exponential backoff + jitter
+(``HVD_KV_RETRIES`` attempts, per-attempt timeout ``HVD_KV_TIMEOUT``):
+a rendezvous server that is still binding, restarting, or sheds a
+request under load (5xx) costs a delay, not the job.  Client errors
+(4xx) are never retried — a 404 is a legitimate "key not there yet"
+answer the callers poll on.
 """
 
 from __future__ import annotations
@@ -15,9 +22,20 @@ import socket
 import time
 import urllib.error
 import urllib.request
+import zlib
 from typing import Optional
 
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.common.retry import retry_call
 from horovod_tpu.runner import secret as secret_mod
+from horovod_tpu.utils import env as env_util
+
+
+def _retryable(e: BaseException) -> bool:
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return isinstance(e, (urllib.error.URLError, ConnectionError,
+                          socket.timeout, TimeoutError, OSError))
 
 
 class KVClient:
@@ -27,6 +45,10 @@ class KVClient:
         self.port = port
         self.secret = (secret if secret is not None
                        else os.environ.get(secret_mod.ENV_VAR) or None)
+        self.attempts = max(1, env_util.get_int("HVD_KV_RETRIES", 4))
+        self.timeout = env_util.get_float("HVD_KV_TIMEOUT", 10.0)
+        self.retry_base = env_util.get_float("HVD_KV_RETRY_BASE_S", 0.05)
+        self.retry_max = env_util.get_float("HVD_KV_RETRY_MAX_S", 2.0)
 
     def _url(self, key: str) -> str:
         return f"http://{self.host}:{self.port}/kv/{key}"
@@ -39,31 +61,52 @@ class KVClient:
                 self.secret, method, f"/kv/{key}", body or b""))
         return req
 
+    def _with_retry(self, fn, site: str, key: str):
+        def attempt():
+            _fi.fire(site, key)
+            return fn()
+
+        return retry_call(
+            attempt, attempts=self.attempts,
+            base_delay=self.retry_base, max_delay=self.retry_max,
+            is_retryable=_retryable,
+            seed=zlib.crc32(key.encode("utf-8")))
+
     def put(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode("utf-8")
-        with urllib.request.urlopen(self._request(key, "PUT", value),
-                                    timeout=10):
-            pass
+
+        def go():
+            with urllib.request.urlopen(self._request(key, "PUT", value),
+                                        timeout=self.timeout):
+                pass
+
+        self._with_retry(go, "kv.put", key)
 
     def get(self, key: str) -> Optional[str]:
         b = self.get_bytes(key)
         return None if b is None else b.decode("utf-8")
 
     def get_bytes(self, key: str) -> Optional[bytes]:
-        try:
-            with urllib.request.urlopen(self._request(key, "GET"),
-                                        timeout=10) as r:
-                return r.read()
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        def go():
+            try:
+                with urllib.request.urlopen(self._request(key, "GET"),
+                                            timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None
+                raise
+
+        return self._with_retry(go, "kv.get", key)
 
     def delete(self, key: str) -> None:
-        with urllib.request.urlopen(self._request(key, "DELETE"),
-                                    timeout=10):
-            pass
+        def go():
+            with urllib.request.urlopen(self._request(key, "DELETE"),
+                                        timeout=self.timeout):
+                pass
+
+        self._with_retry(go, "kv.delete", key)
 
     def wait_get(self, key: str, timeout: float = 60.0,
                  interval: float = 0.05) -> str:

@@ -20,6 +20,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.runner import secret as secret_mod
 
 
@@ -28,6 +29,19 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # silence request logging
         pass
+
+    def _chaos_unavailable(self) -> bool:
+        """Chaos hook: an injected fault turns this request into a 503 —
+        the retryable shed a loaded/restarting rendezvous server would
+        produce."""
+        try:
+            _fi.fire("kv.server.request", f"{self.command} {self.path}")
+        except _fi.InjectedFault:
+            self.send_response(503)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return True
+        return False
 
     def _store(self) -> Dict[str, bytes]:
         return self.server.kv_store  # type: ignore[attr-defined]
@@ -46,6 +60,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if self._chaos_unavailable():
+            return
         if self.path == "/health":
             body = b"ok"
             self.send_response(200)
@@ -73,6 +89,10 @@ class _Handler(BaseHTTPRequestHandler):
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         n = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(n)
+        # Chaos check sits after the body read so a 503 leaves the
+        # keep-alive stream framed correctly.
+        if self._chaos_unavailable():
+            return
         if not self._authorized(body):
             self._reject()
             return
@@ -84,6 +104,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if self._chaos_unavailable():
+            return
         if not self._authorized():
             self._reject()
             return

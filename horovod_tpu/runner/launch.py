@@ -81,6 +81,7 @@ def worker_env(slot: SlotInfo, rdv_addr: str, rdv_port: int,
     if pkg_root not in pp.split(os.pathsep):
         env["PYTHONPATH"] = (pkg_root + os.pathsep + pp) if pp else pkg_root
     env.update({
+        "HVD_HOSTNAME": slot.hostname,
         "HVD_RANK": str(slot.rank),
         "HVD_SIZE": str(slot.size),
         "HVD_LOCAL_RANK": str(slot.local_rank),
@@ -105,11 +106,14 @@ def _stream(proc: subprocess.Popen, rank: int, out,
 
 
 class LaunchError(RuntimeError):
-    def __init__(self, rank: int, returncode: int):
+    def __init__(self, rank: int, returncode: int,
+                 hostname: Optional[str] = None):
         super().__init__(
-            f"worker rank {rank} exited with code {returncode}")
+            f"worker rank {rank} exited with code {returncode}"
+            + (f" on host {hostname}" if hostname else ""))
         self.rank = rank
         self.returncode = returncode
+        self.hostname = hostname
 
 
 def launch_workers(
@@ -181,7 +185,8 @@ def launch_workers(
                 continue
             alive.discard(i)
             if rc != 0:
-                failure = LaunchError(slots[i].rank, rc)
+                failure = LaunchError(slots[i].rank, rc,
+                                      hostname=slots[i].hostname)
                 break
         time.sleep(0.05)
 

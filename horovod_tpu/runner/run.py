@@ -312,7 +312,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         fn_cache = None
         if not args.disable_cache:
             fn_cache = ssh_check.LaunchCache(ssh_check.params_hash(
-                args.np, args.hosts or args.hostfile, args.ssh_port))
+                args.np, args.hosts or args.hostfile, args.ssh_port,
+                args.ssh_identity_file))
         remote = sorted({s.hostname for s in slots
                          if not _is_local(s.hostname)})
         ssh_check.check_hosts_ssh(
@@ -382,14 +383,26 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                     ssh_identity_file=args.ssh_identity_file)
             return subprocess.run(
                 cmd, env=env, stdout=output or None).returncode
+        from horovod_tpu.runner.hosts import HostBlacklist
         from horovod_tpu.runner.launch import LaunchError
 
+        blacklist = HostBlacklist() if args.max_restarts else None
         for attempt in range(args.max_restarts + 1):
             env_try = dict(env_extra)
             if attempt:
                 # Scoped rendezvous keys: the relaunched gang must never
                 # read the dead attempt's stale addresses.
                 env_try["HVD_RDV_SCOPE"] = f"attempt{attempt}"
+                # Skip hosts that keep killing workers, while the rest
+                # still cover -np; a cooled-down host is re-probed.
+                use_hosts = blacklist.filter_hosts(hosts, args.np)
+                skipped = sorted({h.hostname for h in hosts}
+                                 - {h.hostname for h in use_hosts})
+                if skipped:
+                    print(f"{_prog_name()}: skipping blacklisted "
+                          f"host(s) {', '.join(skipped)} on relaunch",
+                          file=sys.stderr)
+                slots = allocate(use_hosts, args.np)
             try:
                 launch_workers(
                     slots, command, addr, port,
@@ -399,10 +412,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                     output=output)
                 return 0
             except LaunchError as e:
+                if blacklist is not None:
+                    blacklist.record_failure(e.hostname)
                 if attempt >= args.max_restarts:
                     raise
                 print(f"{_prog_name()}: rank {e.rank} exited with code "
-                      f"{e.returncode}; restarting the job "
+                      f"{e.returncode}"
+                      + (f" on host {e.hostname}" if e.hostname else "")
+                      + f"; restarting the job "
                       f"(attempt {attempt + 1}/{args.max_restarts})",
                       file=sys.stderr)
         raise AssertionError("unreachable: loop returns or raises")

@@ -78,10 +78,14 @@ class LaunchCache:
 
 
 def params_hash(np: int, hosts: Optional[str],
-                ssh_port: Optional[int]) -> str:
+                ssh_port: Optional[int],
+                ssh_identity_file: Optional[str] = None) -> str:
     """Hash of the launch parameters that affect init checks (parity:
-    run/run.py:600-607 md5 over np + hosts + ssh_port)."""
-    params = f"{np} {hosts or ''} {ssh_port or ''}"
+    run/run.py:600-607 md5 over np + hosts + ssh_port).  The identity
+    file is part of the key: switching credentials must invalidate a
+    cached reachability verdict probed with the old key."""
+    params = (f"{np} {hosts or ''} {ssh_port or ''} "
+              f"{ssh_identity_file or ''}")
     return hashlib.md5(params.encode()).hexdigest()
 
 

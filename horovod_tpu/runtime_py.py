@@ -39,10 +39,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common import wire
 from horovod_tpu.common import response_cache as rcache
 from horovod_tpu.common.types import (
     DataType,
+    RanksFailedError,
     ReduceOp,
     Request,
     RequestType,
@@ -348,6 +350,23 @@ class PyEngine(_EngineBase):
         self._ctrl_lock = threading.Lock()
         self._last_stall_check = time.monotonic()
 
+        # Liveness (parity-extension): heartbeats piggyback on the ctrl
+        # connections; a worker silent past the timeout is evicted via
+        # the Join machinery.  Default OFF (timeout 0) — identical wire
+        # traffic to the pre-heartbeat protocol, and safe to mix with
+        # the native engine, which never sees the new frame tag.
+        self.heartbeat_timeout = env_util.get_float(
+            env_util.HEARTBEAT_TIMEOUT,
+            env_util.get_float("HOROVOD_HEARTBEAT_TIMEOUT", 0.0))
+        self.heartbeat_interval = env_util.get_float(
+            env_util.HEARTBEAT_INTERVAL,
+            max(0.05, self.heartbeat_timeout / 4.0))
+        self._evicted_ranks: set = set()      # dead ranks, every rank
+        self._ranks_failed: List[int] = []    # raises on next enqueue
+        self._conn_lost: set = set()          # recv threads -> coord cycle
+        self._last_seen: Dict[int, float] = {}
+        self._last_send = time.monotonic()
+
         # response cache (parity: response_cache.cc; protocol adapted to
         # the star controller — see common/response_cache.py docstring).
         # All cache state is touched only on the background thread.
@@ -387,6 +406,8 @@ class PyEngine(_EngineBase):
 
         # ctrl receiver threads
         if self.rank == 0:
+            now = time.monotonic()
+            self._last_seen = {r: now for r in self._ctrl_socks}
             for r, s in self._ctrl_socks.items():
                 threading.Thread(target=self._ctrl_recv_loop,
                                  args=(r, s), daemon=True).start()
@@ -401,11 +422,16 @@ class PyEngine(_EngineBase):
         try:
             while not self._shutdown_flag.is_set():
                 tag, payload = su.recv_frame(sock)
+                # Any frame is proof of life; TAG_HEARTBEAT carries
+                # nothing else.
+                self._last_seen[peer_rank] = time.monotonic()
                 if tag == su.TAG_REQUEST_LIST:
                     with self._ctrl_lock:
                         self._ctrl_inbox.append((peer_rank, payload))
         except (ConnectionError, OSError):
-            pass
+            # EOF/reset: fast liveness signal, stronger than a missed
+            # heartbeat (only acted on when heartbeats are enabled).
+            self._conn_lost.add(peer_rank)
 
     def _worker_recv_loop(self) -> None:
         try:
@@ -423,6 +449,10 @@ class PyEngine(_EngineBase):
     # ------------------------------------------------------------------
 
     def _enqueue(self, entry: TensorTableEntry) -> int:
+        if self._ranks_failed:
+            # In-flight ops already completed on the survivors; the next
+            # submission is the point where the training loop can react.
+            raise RanksFailedError(self._ranks_failed)
         if self._aborted or self._shutdown_flag.is_set() \
                 or self._shutdown_requested.is_set():
             raise RuntimeError("horovod_tpu runtime has been shut down")
@@ -657,6 +687,7 @@ class PyEngine(_EngineBase):
             self.handles.mark_done(jh, Status.ok(), None)
 
     def _run_loop_once(self) -> bool:
+        _fi.fire("engine.cycle", str(self.rank))
         with self._queue_lock:
             msgs = self._request_queue
             self._request_queue = []
@@ -739,13 +770,24 @@ class PyEngine(_EngineBase):
                                                shutdown=want_shutdown,
                                                cache_hits=hit_events)
             try:
+                _fi.fire("ctrl.worker.send", str(self.rank))
                 su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
+                self._last_send = time.monotonic()
             except (ConnectionError, OSError):
                 # The coordinator may have closed right after
                 # broadcasting a shutdown ResponseList; the receiver
                 # thread may already hold it — drain before concluding
                 # the peer was genuinely lost.
                 send_failed = True
+        elif self.heartbeat_timeout > 0 and \
+                time.monotonic() - self._last_send >= self.heartbeat_interval:
+            # Idle past the heartbeat cadence: prove liveness.  A lost
+            # coordinator surfaces through the recv loop, not here.
+            try:
+                su.send_frame(self._ctrl_sock, su.TAG_HEARTBEAT, b"")
+            except (ConnectionError, OSError):
+                pass
+            self._last_send = time.monotonic()
         with self._response_lock:
             inbox = self._response_inbox
             self._response_inbox = []
@@ -850,6 +892,13 @@ class PyEngine(_EngineBase):
             for name, pos in peer_hits:
                 _absorb_hit(name, pos, peer)
 
+        # Liveness: evict ranks silent past the heartbeat timeout (or
+        # whose ctrl connection dropped), reusing the Join readiness
+        # machinery so survivors complete in-flight negotiation.
+        dead = self._check_dead_ranks()
+        if dead and not shutdown:
+            self._evict_ranks(dead, ready)
+
         responses: List[Response] = []
         hit_positions: List[int] = []
         for key in ready:
@@ -863,7 +912,10 @@ class PyEngine(_EngineBase):
             hit_ranks = self._hit_ranks.pop(key, set())
             contributors = {r.request_rank for r in reqs}
             ent_pos = -1
-            if hit_ranks >= contributors:
+            # An eviction cycle must ship full responses: workers apply
+            # cached hits BEFORE the response stream, which would run a
+            # collective over the old group before seeing the EVICT.
+            if not dead and hit_ranks >= contributors:
                 # Every contributor hit → all requests were synthesized
                 # from the same cache entry → the negotiated response IS
                 # the cached one; broadcast just the position.
@@ -873,11 +925,20 @@ class PyEngine(_EngineBase):
             else:
                 responses.append(self._construct_response(name, reqs))
 
+        if dead and not shutdown:
+            # First in the stream: every rank applies the eviction before
+            # executing any collective made ready by it.
+            responses.insert(0, Response(
+                response_type=ResponseType.EVICT,
+                tensor_sizes=sorted(dead)))
+
         if len(self._joined_ranks) == self.size:
             responses.append(Response(
                 response_type=ResponseType.JOIN,
                 tensor_sizes=[self._last_joined_rank]))
-            self._joined_ranks = set()
+            # Evicted ranks never un-join: re-seed so post-join traffic
+            # keeps counting them out of readiness.
+            self._joined_ranks = set(self._evicted_ranks)
 
         if not self.stall_check_disable:
             shutdown = self._check_stalls() or shutdown
@@ -908,6 +969,7 @@ class PyEngine(_EngineBase):
                             hit_positions=hit_positions, params=params)
                     payload = shared
                 try:
+                    _fi.fire("ctrl.coord.send", str(r))
                     su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
                 except (ConnectionError, OSError):
                     pass
@@ -934,6 +996,47 @@ class PyEngine(_EngineBase):
                 self._shutdown_flag.set()
                 return False
         return True
+
+    def _check_dead_ranks(self) -> List[int]:
+        """Ranks whose ctrl connection dropped or that have been silent
+        past the heartbeat timeout.  Empty unless liveness is enabled
+        (HVD_HEARTBEAT_TIMEOUT > 0)."""
+        if self.heartbeat_timeout <= 0:
+            return []
+        now = time.monotonic()
+        dead = []
+        for r, t in self._last_seen.items():
+            if r in self._evicted_ranks:
+                continue
+            if r in self._conn_lost or now - t > self.heartbeat_timeout:
+                dead.append(r)
+        return dead
+
+    def _evict_ranks(self, dead: List[int], ready: List[str]) -> None:
+        """Treat ``dead`` as permanently joined: drop their pending
+        requests and rescan readiness so survivors complete the in-flight
+        negotiation with zero stand-ins (the Join contract)."""
+        for r in dead:
+            self.log.error(
+                "rank %d unresponsive (%s); evicting from the job", r,
+                "connection lost" if r in self._conn_lost
+                else f"no heartbeat for {self.heartbeat_timeout:.1f}s")
+            self._evicted_ranks.add(r)
+            self._joined_ranks.add(r)
+        for nm, lst in list(self._msg_table.entries.items()):
+            lst[:] = [q for q in lst
+                      if q.request_rank not in self._evicted_ranks]
+            if not lst:
+                # Only dead ranks had announced it; no survivor holds an
+                # entry, so nothing to complete.
+                self._msg_table.pop(nm)
+                self._hit_ranks.pop(nm, None)
+                if nm in ready:
+                    ready.remove(nm)
+            elif lst[0].process_set_id == 0 and \
+                    len(lst) == self.size - len(self._joined_ranks) and \
+                    nm not in ready:
+                ready.append(nm)
 
     def _check_stalls(self) -> bool:
         now = time.monotonic()
@@ -1171,6 +1274,22 @@ class PyEngine(_EngineBase):
                 self._joined = False
             if jh is not None:
                 self.handles.mark_done(jh, Status.ok(), None)
+            return
+
+        if resp.response_type == ResponseType.EVICT:
+            ranks = [int(x) for x in resp.tensor_sizes]
+            if self.rank in ranks:
+                # The coordinator declared *us* dead (e.g. a long GC
+                # pause): the group has moved on without this rank, so
+                # rejoining is impossible — stop before desyncing it.
+                raise RuntimeError(
+                    "evicted by the coordinator (missed heartbeats)")
+            self._evicted_ranks.update(ranks)
+            self._ranks_failed = sorted(
+                set(self._ranks_failed) | set(ranks))
+            self.log.error(
+                "rank(s) %s evicted; completing in-flight collectives "
+                "on the survivors", ranks)
             return
 
         if resp.response_type == ResponseType.ERROR:

@@ -147,6 +147,13 @@ def materialize(df, store: Store, run_id: str, num_shards: int,
             f"has {num_shards} ranks; every rank needs at least one "
             "validation row — increase the fraction or provide more "
             "rows")
+    if len(pdf) < num_shards:
+        # Same desync hazard on the training side: some ranks would get
+        # an empty shard and fail (or skip steps) mid-gang.
+        raise ValueError(
+            f"training split has {len(pdf)} row(s) but the job has "
+            f"{num_shards} ranks; every rank needs at least one "
+            "training row — provide more rows or reduce num_proc")
     _write_shards(pdf, store, store.train_data_path(run_id), num_shards)
     if val_pdf is not None:
         _write_shards(val_pdf, store, store.val_data_path(run_id),
@@ -156,6 +163,74 @@ def materialize(df, store: Store, run_id: str, num_shards: int,
     store.write_bytes(digest_path,
                       f"{digest}\n{len(pdf)}\n".encode())
     return len(pdf)
+
+
+def _keras_ckpt_encode(weights, opt_vars, history) -> bytes:
+    """Pickle-free epoch-checkpoint codec: weight/slot arrays in an npz
+    archive, history and counts as a JSON blob riding a uint8 array.
+    The store is attacker-writable territory (the trust model
+    ``TorchModel.load`` already assumes) — loading one of these must
+    never be able to execute embedded code."""
+    import io
+    import json
+
+    arrays = {f"w{i}": np.asarray(a) for i, a in enumerate(weights)}
+    n_opt = -1
+    if opt_vars is not None:
+        opt_vars = list(opt_vars)
+        n_opt = len(opt_vars)
+        arrays.update({f"o{i}": np.asarray(a)
+                       for i, a in enumerate(opt_vars)})
+    meta = {"n_weights": len(weights), "n_opt": n_opt,
+            "history": {str(k): [float(x) for x in v]
+                        for k, v in (history or {}).items()}}
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _keras_ckpt_decode(payload: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`_keras_ckpt_encode`; ``allow_pickle=False`` is
+    the point — a poisoned checkpoint fails to parse instead of running."""
+    import io
+    import json
+
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        meta = json.loads(z["meta"].tobytes().decode("utf-8"))
+        weights = [z[f"w{i}"] for i in range(meta["n_weights"])]
+        opt_vars = None if meta["n_opt"] < 0 else \
+            [z[f"o{i}"] for i in range(meta["n_opt"])]
+    return {"weights": weights, "opt_vars": opt_vars,
+            "history": meta["history"]}
+
+
+def _restore_optimizer_slots(variables, saved) -> bool:
+    """Positionally restore optimizer slot values after validating count
+    and shapes.  A checkpoint from a different model/optimizer config
+    must not be zipped in silently (short zip = partial restore); warn
+    and keep fresh optimizer state instead.  Returns True on restore."""
+    import warnings
+
+    saved = list(saved)
+    if len(variables) != len(saved):
+        warnings.warn(
+            f"optimizer checkpoint has {len(saved)} slot variables but "
+            f"the model expects {len(variables)}; ignoring saved "
+            "optimizer state (fresh slots)")
+        return False
+    for var, val in zip(variables, saved):
+        if tuple(var.shape) != tuple(np.shape(val)):
+            warnings.warn(
+                f"optimizer slot {var.name if hasattr(var, 'name') else var} "
+                f"shape {tuple(var.shape)} does not match checkpoint "
+                f"value shape {tuple(np.shape(val))}; ignoring saved "
+                "optimizer state (fresh slots)")
+            return False
+    for var, val in zip(variables, saved):
+        var.assign(val)
+    return True
 
 
 def columns_to_matrix(pdf, cols: Sequence[str]) -> np.ndarray:
@@ -414,9 +489,15 @@ class TorchEstimator(HorovodEstimator):
                     name="est.resume.epoch")
                 if flag is not None:
                     if rank == 0:
+                        # weights_only: the store is writable by anyone
+                        # with filesystem access (same trust model as
+                        # TorchModel.load) — never unpickle arbitrary
+                        # objects from it.  The checkpoint holds only
+                        # tensors and plain containers, all on the
+                        # weights_only allowlist.
                         st = torch.load(_io.BytesIO(ck[1]),
                                         map_location="cpu",
-                                        weights_only=False)
+                                        weights_only=True)
                         local.load_state_dict(st["model"])
                         dist_opt.load_state_dict(st["optimizer"])
                         history = list(st.get("history", []))
@@ -700,9 +781,6 @@ class KerasEstimator(HorovodEstimator):
                 import horovod_tpu.keras as hvd_keras
                 import horovod_tpu.tensorflow as hvd
 
-                import io as _io
-                import pickle
-
                 rank, size = hvd.rank(), hvd.size()
                 X, y = read_shard(store, run_id, rank, size,
                                   feature_cols, label_cols)
@@ -734,7 +812,7 @@ class KerasEstimator(HorovodEstimator):
                 resume = hvd.broadcast_object(
                     None if ck is None else
                     {"epoch": ck[0],
-                     **pickle.loads(ck[1])}, root_rank=0,
+                     **_keras_ckpt_decode(ck[1])}, root_rank=0,
                     name="est.keras.resume")
                 if resume is not None:
                     local.set_weights(resume["weights"])
@@ -744,11 +822,13 @@ class KerasEstimator(HorovodEstimator):
                     # resumed dynamics (Adam moments, LR schedules)
                     # continue instead of restarting (the torch path
                     # restores dist_opt.state_dict() the same way).
+                    # Count/shape-validated: a checkpoint from another
+                    # model config falls back to fresh slots with a
+                    # warning instead of a silent partial restore.
                     if resume.get("opt_vars") is not None:
                         local.optimizer.build(local.trainable_variables)
-                        for var, val in zip(local.optimizer.variables,
-                                            resume["opt_vars"]):
-                            var.assign(val)
+                        _restore_optimizer_slots(
+                            local.optimizer.variables, resume["opt_vars"])
 
                 class _EpochCheckpoint(keras.callbacks.Callback):
                     """Rank 0 writes weights+history to the store after
@@ -765,13 +845,11 @@ class KerasEstimator(HorovodEstimator):
                         if rank == 0:
                             store.save_checkpoint(
                                 run_id, start_epoch + epoch,
-                                pickle.dumps(
-                                    {"weights":
-                                     self.model.get_weights(),
-                                     "opt_vars":
-                                     [np.asarray(v) for v in
-                                      self.model.optimizer.variables],
-                                     "history": self._hist}))
+                                _keras_ckpt_encode(
+                                    self.model.get_weights(),
+                                    [np.asarray(v) for v in
+                                     self.model.optimizer.variables],
+                                    self._hist))
 
                 running_hist = {k: list(v) for k, v in prev_hist.items()}
                 if start_epoch < epochs:

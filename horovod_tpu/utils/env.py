@@ -27,6 +27,18 @@ AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_MAX_SAMPLES = "HVD_AUTOTUNE_MAX_SAMPLES"      # BAYES_OPT_MAX_SAMPLES
 AUTOTUNE_SAMPLE_DURATION = "HVD_AUTOTUNE_SAMPLE_DURATION_SECONDS"
 ADASUM_MODE = "HVD_ADASUM_MODE"
+# Liveness / fault tolerance (PyEngine; 0 = heartbeats disabled).
+# HOROVOD_HEARTBEAT_TIMEOUT is accepted as an alias of the HVD_ name.
+HEARTBEAT_TIMEOUT = "HVD_HEARTBEAT_TIMEOUT"
+HEARTBEAT_INTERVAL = "HVD_HEARTBEAT_INTERVAL"
+# Rendezvous KV client retry policy.
+KV_RETRIES = "HVD_KV_RETRIES"
+KV_TIMEOUT = "HVD_KV_TIMEOUT"
+KV_RETRY_BASE_S = "HVD_KV_RETRY_BASE_S"
+KV_RETRY_MAX_S = "HVD_KV_RETRY_MAX_S"
+# Launcher host blacklist (relaunch path).
+BLACKLIST_THRESHOLD = "HVD_BLACKLIST_THRESHOLD"
+BLACKLIST_COOLDOWN_S = "HVD_BLACKLIST_COOLDOWN_S"
 
 
 def get_bool(name: str, default: bool = False) -> bool:

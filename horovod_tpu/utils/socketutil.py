@@ -11,6 +11,8 @@ import socket
 import struct
 from typing import Optional, Tuple
 
+from horovod_tpu.common import fault_injection as _fi
+
 HEADER = struct.Struct("<BI")
 
 # Channel tags.
@@ -18,13 +20,16 @@ TAG_REQUEST_LIST = 1
 TAG_RESPONSE_LIST = 2
 TAG_DATA = 3
 TAG_KV = 4
+TAG_HEARTBEAT = 5
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:
+    _fi.fire("sock.send", str(tag))
     sock.sendall(HEADER.pack(tag, len(payload)) + payload)
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
+    _fi.fire("sock.recv")
     chunks = []
     got = 0
     while got < n:
@@ -52,17 +57,28 @@ def listen_on(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
 
 def connect_retry(host: str, port: int, timeout: float = 30.0,
                   interval: float = 0.05) -> socket.socket:
+    """Dial ``host:port`` until ``timeout``, with capped exponential
+    backoff + jitter between attempts (``interval`` seeds the backoff
+    base) so a gang of workers dialing one listener does not retry in
+    lockstep."""
     import time
 
+    from horovod_tpu.common.retry import backoff_delays
+
     deadline = time.monotonic() + timeout
-    last = None
+    delays = iter(backoff_delays(
+        attempts=64, base_delay=interval, max_delay=1.0, jitter=0.5,
+        seed=port))
+    last: Optional[OSError] = None
     while time.monotonic() < deadline:
         try:
+            _fi.fire("sock.connect", f"{host}:{port}")
             s = socket.create_connection((host, port), timeout=5.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             s.settimeout(None)
             return s
         except OSError as e:
             last = e
-            time.sleep(interval)
+            d = next(delays, 1.0)
+            time.sleep(min(d, max(0.0, deadline - time.monotonic())))
     raise ConnectionError(f"cannot connect to {host}:{port}: {last}")

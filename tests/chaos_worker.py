@@ -1,0 +1,103 @@
+"""Chaos-scenario worker for tests/test_chaos.py.
+
+Each rank runs ONE scenario named on the command line; per-rank fault
+plans arrive through ``HOROVOD_FAULT_PLAN`` in the environment (the same
+channel a real chaos run would use).  Markers are printed with
+``flush=True`` so the driving test can parse them from captured stdout
+even when a rank dies abruptly.
+
+Exit codes are part of the contract:
+
+* 0   — scenario completed (including the *expected* RanksFailedError on
+        survivor ranks)
+* 3   — a failure that was supposed to happen never did
+* 17  — this rank lost its control connection and aborted (the ctrl_drop
+        victim's expected death)
+* 137 — killed by an injected ``kill`` fault (``os._exit(137)``)
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+STEPS = 8
+
+
+def scenario_bootstrap_allreduce(hvd, fi):
+    """Plain init + one allreduce.  Interesting only because the fault
+    plan in the environment makes the rendezvous KV flaky: bootstrap must
+    come up through client-side retries alone."""
+    out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum,
+                        name="chaos.boot")
+    assert float(out[0]) == hvd.size(), out
+    print(f"BOOT_OK {hvd.rank()}", flush=True)
+    hvd.shutdown()
+
+
+def scenario_train_steps(hvd, fi):
+    """A training loop under chaos.  The victim rank's plan fires at the
+    ``train.step`` site (kill) or at ``ctrl.worker.send`` (drop); the
+    survivors' path is: completed step over the full gang, one completed
+    step over the survivors after eviction, then RanksFailedError on the
+    next submission — the signal to checkpoint and let the launcher
+    relaunch."""
+    rank = hvd.rank()
+    step = -1
+    try:
+        for step in range(STEPS):
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name=f"chaos.step{step}")
+            print(f"STEP {step} {float(out[0])}", flush=True)
+            fi.fire("train.step", str(step))
+        print("NO_FAILURE", flush=True)
+        os._exit(3)  # the injected fault never bit
+    except hvd.RanksFailedError as e:
+        # ``step`` is the submission that raised: steps [0, step) are
+        # complete on the survivors, so a resume restarts at ``step``.
+        print(f"RANKS_FAILED {json.dumps(e.ranks)} at_step {step}",
+              flush=True)
+        ckpt_dir = os.environ.get("CHAOS_CKPT_DIR")
+        if ckpt_dir:
+            # The survivors are still healthy enough to checkpoint —
+            # that is the whole point of surfacing a typed error instead
+            # of hanging.
+            path = os.path.join(ckpt_dir, f"ckpt-rank{rank}.json")
+            with open(path, "w") as f:
+                json.dump({"rank": rank, "next_step": step,
+                           "failed_ranks": e.ranks}, f)
+        os._exit(0)
+    except RuntimeError as e:
+        # The ctrl_drop victim: its dropped send looks like a lost
+        # coordinator, the engine aborts, the blocked allreduce raises.
+        print(f"CTRL_LOST {rank}: {e}", flush=True)
+        os._exit(17)
+
+
+SCENARIOS = {
+    "bootstrap_allreduce": scenario_bootstrap_allreduce,
+    "train_steps": scenario_train_steps,
+}
+
+
+def main():
+    name = sys.argv[1]
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection as fi
+
+    hvd.init()
+    expect = os.environ.get("HVD_EXPECT_ENGINE")
+    if expect:
+        from horovod_tpu import basics
+
+        actual = type(basics._runtime).__name__
+        assert actual == expect, (actual, expect)
+    try:
+        SCENARIOS[name](hvd, fi)
+    finally:
+        hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

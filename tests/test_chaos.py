@@ -1,0 +1,468 @@
+"""Chaos harness: fault-injection semantics, retry/backoff, the
+self-healing control plane (heartbeat liveness -> dead-rank eviction ->
+``RanksFailedError``), and the launcher host blacklist.
+
+Everything here is deterministic on CPU.  Multi-process scenarios reuse
+the loopback-mesh fixture idiom from test_multiprocess.py, with per-rank
+``HOROVOD_FAULT_PLAN`` environments driving the chaos (the victim rank
+gets the plan; the survivors prove the healing).
+"""
+
+import gc
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import tracemalloc
+import urllib.error
+
+import pytest
+
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.common.retry import backoff_delays, retry_call
+from horovod_tpu.runner.http_server import RendezvousServer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "chaos_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """A fault plan must never leak across tests (it is process-global)."""
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault-plan semantics (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_fire_is_free_when_disabled():
+    """With no plan, fire() must be a single global check: no allocation
+    (pinned via tracemalloc) — the hooks stay in production code paths."""
+    assert not fi.active()
+    fi.fire("sock.send", "warmup")
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.get_traced_memory()[0]
+    for _ in range(10000):
+        fi.fire("sock.send", "3")
+    after = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+    assert after - before < 512, (before, after)
+
+
+def test_fault_times_and_after():
+    fi.configure({"faults": [
+        {"site": "s", "kind": "error", "times": 2, "after": 1}]})
+    fi.fire("s")  # pass 1 skipped by `after`
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("s")
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("s")
+    fi.fire("s")  # `times` exhausted -> clean again
+
+
+def test_fault_match_scopes_by_detail():
+    fi.configure({"faults": [
+        {"site": "kv.put", "kind": "error", "match": "rdv/"}]})
+    fi.fire("kv.put", "runfunc/result/0")  # detail mismatch
+    fi.fire("kv.get", "rdv/addr0")         # site mismatch
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("kv.put", "rdv/addr0")
+
+
+def test_fault_prob_deterministic_under_seed():
+    def pattern(seed):
+        fi.configure({"seed": seed, "faults": [
+            {"site": "s", "kind": "error", "prob": 0.5}]})
+        hits = []
+        for _ in range(64):
+            try:
+                fi.fire("s")
+                hits.append(False)
+            except fi.InjectedFault:
+                hits.append(True)
+        return hits
+
+    a = pattern(7)
+    assert a == pattern(7)          # same seed -> same chaos, replayable
+    assert any(a) and not all(a)    # p=0.5 over 64 draws fires partially
+
+
+def test_fault_delay_sleeps_without_raising():
+    fi.configure({"faults": [
+        {"site": "s", "kind": "delay", "delay_s": 0.05, "times": 1}]})
+    t0 = time.monotonic()
+    fi.fire("s")
+    assert time.monotonic() - t0 >= 0.04
+    t0 = time.monotonic()
+    fi.fire("s")  # exhausted: no sleep
+    assert time.monotonic() - t0 < 0.04
+
+
+def test_plan_env_loading_inline_and_file(tmp_path, monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR,
+                       '{"faults": [{"site": "x", "kind": "error"}]}')
+    fi._load_from_env()
+    assert fi.active()
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("x")
+    fi.clear()
+    plan = tmp_path / "plan.json"
+    plan.write_text('{"faults": [{"site": "y", "kind": "drop"}]}')
+    monkeypatch.setenv(fi.ENV_VAR, str(plan))
+    fi._load_from_env()
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("y")
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        fi.configure({"faults": [{"site": "s", "kind": "explode"}]})
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_deterministic_and_capped():
+    a = backoff_delays(6, 0.05, 0.4, 0.5, seed=3)
+    assert a == backoff_delays(6, 0.05, 0.4, 0.5, seed=3)
+    assert len(a) == 5
+    for i, d in enumerate(a):
+        raw = min(0.4, 0.05 * 2.0 ** i)
+        assert raw <= d <= raw * 1.5 + 1e-9
+
+
+def test_retry_call_recovers_and_reports():
+    calls, notes = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    assert retry_call(flaky, attempts=4, base_delay=0.001, max_delay=0.002,
+                      on_retry=lambda i, e: notes.append(i)) == "ok"
+    assert len(calls) == 3
+    assert notes == [1, 2]
+
+
+def test_retry_call_non_retryable_raises_immediately():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("no")
+
+    with pytest.raises(ValueError):
+        retry_call(fatal, attempts=5, base_delay=0.001,
+                   is_retryable=lambda e: isinstance(e, ConnectionError))
+    assert len(calls) == 1
+
+
+def test_retry_call_exhaustion_and_deadline():
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry_call(always, attempts=3, base_delay=0.001, max_delay=0.002)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        retry_call(always, attempts=50, base_delay=0.05, max_delay=0.05,
+                   deadline=time.monotonic() + 0.15)
+    assert time.monotonic() - t0 < 1.0  # deadline beat the attempt count
+
+
+# ---------------------------------------------------------------------------
+# KV client/server under chaos (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_client_retries_through_server_503s(monkeypatch):
+    monkeypatch.delenv("HVD_SECRET_KEY", raising=False)
+    monkeypatch.setenv("HVD_KV_RETRY_BASE_S", "0.01")
+    from horovod_tpu.runner.http_client import KVClient
+
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    try:
+        kv = KVClient("127.0.0.1", port)
+        fi.configure({"faults": [
+            {"site": "kv.server.request", "kind": "error", "times": 3}]})
+        kv.put("chaos/key", b"v1")  # 3x 503, lands on the 4th attempt
+        assert kv.get_bytes("chaos/key") == b"v1"
+        fi.clear()
+        assert kv.get_bytes("chaos/missing") is None  # 404 is an answer
+        # An outage longer than the retry budget still surfaces.
+        fi.configure({"faults": [
+            {"site": "kv.server.request", "kind": "error", "times": 99}]})
+        with pytest.raises(urllib.error.HTTPError):
+            kv.put("chaos/key2", b"x")
+    finally:
+        fi.clear()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# liveness bookkeeping (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_check_dead_ranks_semantics():
+    from horovod_tpu.runtime_py import PyEngine
+
+    eng = object.__new__(PyEngine)
+    now = time.monotonic()
+    eng.heartbeat_timeout = 0.0
+    eng._evicted_ranks = set()
+    eng._conn_lost = set()
+    eng._last_seen = {1: now - 99.0, 2: now}
+    assert eng._check_dead_ranks() == []  # disabled by default
+    eng.heartbeat_timeout = 1.0
+    assert eng._check_dead_ranks() == [1]         # silent past timeout
+    eng._conn_lost.add(2)
+    assert sorted(eng._check_dead_ranks()) == [1, 2]  # EOF beats timer
+    eng._evicted_ranks.add(1)
+    assert eng._check_dead_ranks() == [2]         # evict only once
+
+
+def test_ranks_failed_error_exported():
+    import horovod_tpu as hvd
+
+    err = hvd.RanksFailedError([3, 1])
+    assert isinstance(err, RuntimeError)
+    assert err.ranks == [1, 3]
+    assert "evicted" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# multi-process chaos scenarios
+# ---------------------------------------------------------------------------
+
+
+def run_chaos(scenario, np_, *, base_env=None, rank_env=None,
+              timeout=120.0):
+    """Spawn an np_-rank gang of chaos_worker.py on the loopback mesh
+    (PyEngine on every rank — EVICT is a PyEngine extension) and return
+    per-rank (exit_code, stdout, stderr).  Exit codes are asserted by the
+    caller: chaos gangs *expect* some ranks to die."""
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.pop(fi.ENV_VAR, None)
+            env["PYTHONPATH"] = (REPO + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_CROSS_RANK": "0",
+                "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_CORE": "py",
+                "HVD_EXPECT_ENGINE": "PyEngine",
+            })
+            if base_env:
+                env.update(base_env)
+            if rank_env and rank in rank_env:
+                env.update(rank_env[rank])
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + timeout
+        outs = []
+        for p in procs:
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"chaos scenario {scenario}: worker timed out")
+            outs.append((p.returncode, out.decode(), err.decode()))
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def _steps(out):
+    return [(int(m.group(1)), float(m.group(2)))
+            for m in re.finditer(r"STEP (\d+) ([\d.]+)", out)]
+
+
+HEARTBEAT_ENV = {"HVD_HEARTBEAT_TIMEOUT": "2.0",
+                 "HVD_HEARTBEAT_INTERVAL": "0.25"}
+
+
+def test_chaos_bootstrap_through_flaky_kv():
+    """Scenario (a): every rank's first rendezvous KV put/get fails twice
+    (injected client-side); bootstrap must come up through the retry
+    policy alone — no code path changes, no operator action."""
+    plan = json.dumps({"faults": [
+        {"site": "kv.put", "kind": "error", "times": 2},
+        {"site": "kv.get", "kind": "error", "times": 2},
+    ]})
+    outs = run_chaos("bootstrap_allreduce", 2,
+                     base_env={fi.ENV_VAR: plan,
+                               "HVD_KV_RETRY_BASE_S": "0.02"})
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+        assert f"BOOT_OK {rank}" in out
+
+
+def test_chaos_sigkilled_rank_evicted_survivors_raise(tmp_path):
+    """Scenario (b): rank 2 of 3 dies SIGKILL-style after step 2.  The
+    coordinator evicts it within the heartbeat window; the survivors'
+    in-flight step 3 completes over the survivor group (no stand-ins, no
+    hang), the next submission raises RanksFailedError, and the survivors
+    are healthy enough to write a checkpoint."""
+    np_, victim = 3, 2
+    plan = json.dumps({"faults": [
+        {"site": "train.step", "kind": "kill", "after": 2}]})
+    outs = run_chaos(
+        "train_steps", np_,
+        base_env={**HEARTBEAT_ENV, "CHAOS_CKPT_DIR": str(tmp_path)},
+        rank_env={victim: {fi.ENV_VAR: plan}})
+
+    v_code, v_out, v_err = outs[victim]
+    assert v_code == 137, (v_code, v_out, v_err)
+    assert _steps(v_out)[-1][0] == 2  # completed steps 0-2, then died
+
+    for rank in range(np_ - 1):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        steps = dict(_steps(out))
+        assert steps[0] == 3.0 and steps[2] == 3.0  # full gang
+        assert steps[3] == 2.0  # post-eviction: survivors only
+        assert f"RANKS_FAILED [{victim}] at_step 4" in out, out
+        ck = json.loads(
+            (tmp_path / f"ckpt-rank{rank}.json").read_text())
+        assert ck["failed_ranks"] == [victim]
+        assert ck["next_step"] == 4
+
+
+def test_chaos_ctrl_drop_victim_aborts_and_is_evicted():
+    """Scenario (b'): instead of dying, the victim's control-plane send
+    is dropped (network fault).  The victim aborts fast ('lost
+    coordinator'), the coordinator evicts it on connection loss, and the
+    survivors complete the orphaned step over the reduced group before
+    surfacing RanksFailedError."""
+    np_, victim = 3, 2
+    plan = json.dumps({"faults": [
+        {"site": "ctrl.worker.send", "kind": "drop",
+         "times": 1, "after": 2}]})
+    outs = run_chaos("train_steps", np_, base_env=HEARTBEAT_ENV,
+                     rank_env={victim: {fi.ENV_VAR: plan}})
+
+    v_code, v_out, v_err = outs[victim]
+    assert v_code == 17, (v_code, v_out, v_err)
+    assert "CTRL_LOST" in v_out
+
+    for rank in range(np_ - 1):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        assert f"RANKS_FAILED [{victim}]" in out, out
+        steps = _steps(out)
+        assert steps and steps[-1][1] == 2.0, steps  # survivor-group step
+
+
+# ---------------------------------------------------------------------------
+# host blacklist
+# ---------------------------------------------------------------------------
+
+
+def test_host_blacklist_threshold_and_decay():
+    from horovod_tpu.runner.hosts import HostBlacklist
+
+    bl = HostBlacklist(threshold=2, cooldown_s=10.0)
+    bl.record_failure("a", now=100.0)
+    assert not bl.is_blacklisted("a", now=100.0)
+    bl.record_failure("a", now=101.0)
+    assert bl.is_blacklisted("a", now=101.0)
+    assert bl.failure_count("a", now=105.0) == 2
+    # failures age out: the host gets re-probed instead of banned forever
+    assert not bl.is_blacklisted("a", now=112.0)
+    bl.record_failure("", now=0.0)  # unknown host: no-op, no crash
+    assert bl.failure_count("", now=0.0) == 0
+
+
+def test_host_blacklist_filter_keeps_capacity():
+    from horovod_tpu.runner.hosts import HostBlacklist, HostSlots
+
+    hosts = [HostSlots("bad", 2), HostSlots("good", 2)]
+    bl = HostBlacklist(threshold=1, cooldown_s=300.0)
+    bl.record_failure("bad")
+    assert [h.hostname for h in bl.filter_hosts(hosts, 2)] == ["good"]
+    # dropping below -np capacity returns the full list: a degraded host
+    # beats no relaunch at all
+    assert bl.filter_hosts(hosts, 3) == hosts
+
+
+HOST_PICKY_WORKER = """\
+import os, sys
+
+if os.environ.get("HVD_HOSTNAME") == "127.0.0.1":
+    sys.exit(7)
+print("ok on %s rank %s" % (os.environ.get("HVD_HOSTNAME"),
+                            os.environ.get("HVD_RANK")), flush=True)
+"""
+
+
+def test_cli_blacklists_failing_host_on_relaunch(tmp_path):
+    """Scenario (c): both slots of the first attempt land on 127.0.0.1,
+    whose workers always die; with HVD_BLACKLIST_THRESHOLD=1 the relaunch
+    skips that host and completes on localhost."""
+    prog = tmp_path / "prog.py"
+    prog.write_text(HOST_PICKY_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HVD_BLACKLIST_THRESHOLD"] = "1"
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run",
+         "-np", "2", "-H", "127.0.0.1:2,localhost:2",
+         "--max-restarts", "2",
+         sys.executable, str(prog)],
+        capture_output=True, text=True, timeout=90, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "skipping blacklisted host(s) 127.0.0.1" in res.stderr, \
+        res.stderr
+    assert "ok on localhost rank 0" in res.stdout
+    assert "ok on localhost rank 1" in res.stdout
+
+
+def test_launch_error_carries_hostname():
+    from horovod_tpu.runner.launch import LaunchError
+
+    e = LaunchError(3, 137, hostname="worker-7")
+    assert e.rank == 3 and e.returncode == 137
+    assert e.hostname == "worker-7"
+    assert "worker-7" in str(e)
+
+
+def test_ssh_params_hash_includes_identity_file():
+    from horovod_tpu.runner.ssh_check import params_hash
+
+    base = params_hash(4, "a:2,b:2", 22)
+    assert params_hash(4, "a:2,b:2", 22) == base  # stable
+    with_id = params_hash(4, "a:2,b:2", 22, "/home/u/.ssh/id_a")
+    assert with_id != base
+    assert with_id != params_hash(4, "a:2,b:2", 22, "/home/u/.ssh/id_b")

@@ -125,16 +125,48 @@ def test_bridge_misuse_inside_shard_map_raises(monkeypatch):
     def body(x):
         return bridge.allreduce(x, name="misuse")
 
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
 
     f = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
     with pytest.raises(TypeError, match="shard_map"):
         f(jnp.ones((4,), jnp.float32))
 
-    # Layer 2: probe API gone -> operand-tracer detection must still raise.
+    # Layer 2: probe API gone -> fallback detection must still raise.
     import jax.core as jcore
 
     monkeypatch.delattr(jcore, "nonempty_axis_env_DO_NOT_USE",
                         raising=False)
     with pytest.raises(TypeError, match="shard_map"):
         f(jnp.ones((4,), jnp.float32))
+
+
+def test_bridge_misuse_inside_pmap_raises(monkeypatch):
+    """Same misuse guard for pmap (whose tracers ride the ordinary jaxpr
+    machinery on current jax — the label match alone cannot see them):
+    pinned with the probe present AND with it hidden, so the fallback
+    layers keep pmap misuse a raise rather than a hang."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import bridge
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+
+    def body(x):
+        return bridge.allreduce(x, name="misuse.pmap")
+
+    f = jax.pmap(body)
+    x = jnp.ones((2, 4), jnp.float32)
+    with pytest.raises(TypeError, match="pmap"):
+        f(x)
+
+    import jax.core as jcore
+
+    monkeypatch.delattr(jcore, "nonempty_axis_env_DO_NOT_USE",
+                        raising=False)
+    with pytest.raises(TypeError, match="pmap"):
+        f(x)

@@ -512,3 +512,139 @@ def test_materialize_skips_rewrite_for_identical_data(tmp_path):
     # different shard count must also re-materialize
     materialize(df, store, "rc", 4)
     assert len(store.shard_paths("rc")) == 4
+
+
+# ---------------------------------------------------------------------------
+# resume trust model + split guards (robustness satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_materialize_train_split_smaller_than_ranks_fails_fast(tmp_path):
+    """A training split with fewer rows than ranks must fail at
+    materialize time with a named error, not as empty-shard collective
+    desync on some ranks mid-gang."""
+    from horovod_tpu.spark.estimator import materialize
+    from horovod_tpu.spark.store import Store
+
+    df, _, _ = _teacher_frame(16, 4)
+    store = Store.create(str(tmp_path))
+    with pytest.raises(ValueError, match="at least one training row"):
+        materialize(df.head(3), store, "rsmall", 4)
+    # boundary: exactly one row per rank is fine
+    assert materialize(df.head(4), store, "rok", 4) == 4
+
+
+def test_keras_ckpt_codec_roundtrip_pickle_free():
+    from horovod_tpu.spark.estimator import (_keras_ckpt_decode,
+                                             _keras_ckpt_encode)
+
+    weights = [np.arange(6, dtype=np.float32).reshape(2, 3),
+               np.ones(3, np.float64)]
+    opt_vars = [np.zeros(4, np.float32), np.float32(7.0)]
+    hist = {"loss": [1.5, 0.5], "val_loss": [2.0, 1.0]}
+    out = _keras_ckpt_decode(_keras_ckpt_encode(weights, opt_vars, hist))
+    for a, b in zip(out["weights"], weights):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(out["opt_vars"], opt_vars):
+        np.testing.assert_array_equal(a, b)
+    assert out["history"] == hist
+
+    # no optimizer state is a first-class value, not an empty list
+    out2 = _keras_ckpt_decode(_keras_ckpt_encode(weights, None, {}))
+    assert out2["opt_vars"] is None
+    assert out2["history"] == {}
+
+
+def test_keras_ckpt_decode_rejects_pickle_payloads(tmp_path):
+    """The epoch-checkpoint store is attacker-writable territory: a
+    poisoned checkpoint must fail to parse, never execute.  Pinned
+    against both a raw legacy-pickle payload and an npz smuggling an
+    object array."""
+    import io
+    import pickle
+
+    from horovod_tpu.spark.estimator import _keras_ckpt_decode
+
+    sentinel = tmp_path / "owned"
+
+    class Evil:
+        def __reduce__(self):
+            return (open, (str(sentinel), "w"))
+
+    with pytest.raises(Exception):
+        _keras_ckpt_decode(pickle.dumps({"weights": Evil()}))
+    assert not sentinel.exists(), "pickle payload executed on load!"
+
+    buf = io.BytesIO()
+    np.savez(buf, meta=np.array([{"evil": Evil()}], dtype=object))
+    with pytest.raises(ValueError):
+        _keras_ckpt_decode(buf.getvalue())
+    assert not sentinel.exists(), "object array executed on load!"
+
+
+class _FakeVar:
+    def __init__(self, shape, name="v"):
+        self.shape = tuple(shape)
+        self.name = name
+        self.value = np.zeros(shape, np.float32)
+
+    def assign(self, val):
+        self.value = np.array(val, np.float32)
+
+
+def test_restore_optimizer_slots_validates_count_and_shape():
+    from horovod_tpu.spark.estimator import _restore_optimizer_slots
+
+    variables = [_FakeVar((2, 3), "m"), _FakeVar((3,), "s")]
+    good = [np.full((2, 3), 2.0, np.float32), np.full(3, 5.0, np.float32)]
+    assert _restore_optimizer_slots(variables, good) is True
+    np.testing.assert_array_equal(variables[0].value, good[0])
+    np.testing.assert_array_equal(variables[1].value, good[1])
+
+    # count mismatch: warn + fresh slots, nothing assigned
+    variables = [_FakeVar((2, 3))]
+    with pytest.warns(UserWarning, match="slot variables"):
+        assert _restore_optimizer_slots(variables, good) is False
+    np.testing.assert_array_equal(variables[0].value, np.zeros((2, 3)))
+
+    # shape mismatch anywhere: no partial zip — even the vars that DID
+    # match stay untouched
+    variables = [_FakeVar((2, 3)), _FakeVar((4,))]
+    with pytest.warns(UserWarning, match="shape"):
+        assert _restore_optimizer_slots(variables, good) is False
+    np.testing.assert_array_equal(variables[0].value, np.zeros((2, 3)))
+    np.testing.assert_array_equal(variables[1].value, np.zeros(4))
+
+
+def test_torch_resume_rejects_poisoned_checkpoint(tmp_path):
+    """weights_only resume: a checkpoint smuggling a pickle gadget must
+    fail the fit, and the gadget must never run (regression for the
+    full-pickle torch.load the resume path used to do)."""
+    import pickle
+
+    torch = pytest.importorskip("torch")
+    from horovod_tpu.spark import LocalBackend, TorchEstimator
+    from horovod_tpu.spark.store import Store
+
+    sentinel = tmp_path / "owned"
+
+    class Evil:
+        def __reduce__(self):
+            return (open, (str(sentinel), "w"))
+
+    store = Store.create(str(tmp_path / "store"))
+    store.save_checkpoint("poisoned", 0,
+                          pickle.dumps({"model": Evil()}))
+
+    df, _, _ = _teacher_frame(64, 6)
+    model = torch.nn.Linear(6, 1)
+    est = TorchEstimator(
+        model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.05),
+        loss=torch.nn.MSELoss(),
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=32, epochs=1, num_proc=2,
+        store=store, backend=LocalBackend(2), run_id="poisoned")
+    with pytest.raises(Exception):
+        est.fit(df)
+    assert not sentinel.exists(), "poisoned checkpoint executed on load!"
