@@ -167,7 +167,8 @@ Response DecodeResponse(Reader& rd) {
 
 std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
                                        bool shutdown,
-                                       const std::vector<CacheHit>& hits) {
+                                       const std::vector<CacheHit>& hits,
+                                       uint32_t epoch) {
   std::vector<uint8_t> b;
   PutU8(b, shutdown ? 1 : 0);
   PutU32(b, static_cast<uint32_t>(reqs.size()));
@@ -177,12 +178,13 @@ std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
     PutStr(b, h.name);
     PutU32(b, h.position);
   }
+  PutU32(b, epoch);
   return b;
 }
 
 bool DecodeRequestList(const uint8_t* data, size_t len,
                        std::vector<Request>* out, bool* shutdown,
-                       std::vector<CacheHit>* hits) {
+                       std::vector<CacheHit>* hits, uint32_t* epoch) {
   Reader rd{data, len};
   *shutdown = rd.U8() != 0;
   uint32_t n = rd.U32();
@@ -195,13 +197,17 @@ bool DecodeRequestList(const uint8_t* data, size_t len,
     h.position = rd.U32();
     hits->push_back(std::move(h));
   }
+  // Optional epoch trailer (0 on frames that predate it).
+  uint32_t e = (!rd.fail && rd.off + 4 <= rd.len) ? rd.U32() : 0;
+  if (epoch) *epoch = e;
   return !rd.fail;
 }
 
 std::vector<uint8_t> EncodeResponseList(
     const std::vector<Response>& resps, bool shutdown,
     const std::vector<uint32_t>& hit_positions,
-    const std::vector<std::string>& resend_names, const WireParams& params) {
+    const std::vector<std::string>& resend_names, const WireParams& params,
+    uint32_t epoch) {
   std::vector<uint8_t> b;
   PutU8(b, shutdown ? 1 : 0);
   PutU32(b, static_cast<uint32_t>(resps.size()));
@@ -218,6 +224,7 @@ std::vector<uint8_t> EncodeResponseList(
     PutU8(b, params.hierarchical_allreduce ? 1 : 0);
     PutU8(b, params.hierarchical_allgather ? 1 : 0);
   }
+  PutU32(b, epoch);
   return b;
 }
 
@@ -225,7 +232,7 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
                         std::vector<Response>* out, bool* shutdown,
                         std::vector<uint32_t>* hit_positions,
                         std::vector<std::string>* resend_names,
-                        WireParams* params) {
+                        WireParams* params, uint32_t* epoch) {
   Reader rd{data, len};
   *shutdown = rd.U8() != 0;
   uint32_t n = rd.U32();
@@ -245,6 +252,9 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
     params->hierarchical_allreduce = rd.U8() != 0;
     params->hierarchical_allgather = rd.U8() != 0;
   }
+  // Optional epoch trailer (0 on frames that predate it).
+  uint32_t e = (!rd.fail && rd.off + 4 <= rd.len) ? rd.U32() : 0;
+  if (epoch) *epoch = e;
   return !rd.fail;
 }
 

@@ -63,13 +63,22 @@ struct CacheHit {
   uint32_t position = 0;
 };
 
+// List frames end with an optional u32 `epoch` trailer — the elastic
+// membership epoch (horovod_tpu/elastic; 0 when absent).  The native
+// engine only ever runs at epoch 0 (elastic requires the Python engine;
+// Engine raises before construction otherwise) but both codecs carry
+// the trailer so the layout spec in horovod_tpu/common/wire.py and this
+// header stay in lockstep.
 std::vector<uint8_t> EncodeRequestList(const std::vector<Request>& reqs,
                                        bool shutdown,
-                                       const std::vector<CacheHit>& hits = {});
-// Returns false on malformed input.
+                                       const std::vector<CacheHit>& hits = {},
+                                       uint32_t epoch = 0);
+// Returns false on malformed input.  `epoch` (optional out) receives
+// the trailer, 0 when the frame predates it.
 bool DecodeRequestList(const uint8_t* data, size_t len,
                        std::vector<Request>* out, bool* shutdown,
-                       std::vector<CacheHit>* hits);
+                       std::vector<CacheHit>* hits,
+                       uint32_t* epoch = nullptr);
 
 // Autotuner knob broadcast riding the response stream (parity: rank-0
 // Params bcast, parameter_manager.cc via controller.cc:33-47).
@@ -86,11 +95,11 @@ std::vector<uint8_t> EncodeResponseList(
     const std::vector<Response>& resps, bool shutdown,
     const std::vector<uint32_t>& hit_positions = {},
     const std::vector<std::string>& resend_names = {},
-    const WireParams& params = {});
+    const WireParams& params = {}, uint32_t epoch = 0);
 bool DecodeResponseList(const uint8_t* data, size_t len,
                         std::vector<Response>* out, bool* shutdown,
                         std::vector<uint32_t>* hit_positions,
                         std::vector<std::string>* resend_names,
-                        WireParams* params);
+                        WireParams* params, uint32_t* epoch = nullptr);
 
 }  // namespace hvd

@@ -85,6 +85,7 @@ from horovod_tpu.parallel.optimizer import (  # noqa: F401
     distributed_value_and_grad,
 )
 from horovod_tpu import data  # noqa: F401  (sharded sampling + prefetch)
+from horovod_tpu import elastic  # noqa: F401  (commit/rollback + re-form)
 from horovod_tpu.parallel.multihost import (  # noqa: F401
     init_jax_distributed,
 )

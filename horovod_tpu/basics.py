@@ -99,6 +99,16 @@ def _make_engine(r, s, lr, ls, cr, cs):
     addr = os.environ.get(_Env.RENDEZVOUS_ADDR, "127.0.0.1")
     port = int(os.environ.get(_Env.RENDEZVOUS_PORT, "0"))
     try:
+        if os.environ.get("HVD_ELASTIC_EPOCH", "") and \
+                os.environ.get("HVD_TPU_CORE", "").lower() not in (
+                    "py", "python"):
+            # The native engine has no in-process reset path (its epoch
+            # is pinned to 0 on the wire), so elastic training requires
+            # the Python engine.  `hvdrun --min-np/--max-np` sets
+            # HVD_TPU_CORE=py automatically; direct users must too.
+            raise NotImplementedError(
+                "elastic training (HVD_ELASTIC_EPOCH) is not supported "
+                "by the native engine; set HVD_TPU_CORE=py")
         from horovod_tpu.runtime_native import NativeEngine
         from horovod_tpu import native
 

@@ -17,7 +17,8 @@ Layout (all integers little-endian):
               i32 process_set_id, i32 process_set_size
   CacheHit := varstr name, u32 position
   RequestList  := u8 shutdown, u32 n, Request[n],
-                  u32 n_hits, CacheHit[n_hits]
+                  u32 n_hits, CacheHit[n_hits],
+                  [ u32 epoch ]                   # optional trailer
   Response := u8 response_type, u8 tensor_type, u32 n_names,
               varstr[n_names], varstr error_message,
               u32 n_devices, varstr[n_devices],
@@ -31,7 +32,19 @@ Layout (all integers little-endian):
                   u8 has_params,
                   [ i64 fusion_threshold, f64 cycle_time_s,
                     u8 cache_enabled, u8 hierarchical_allreduce,
-                    u8 hierarchical_allgather ]   # iff has_params
+                    u8 hierarchical_allgather ],  # iff has_params
+                  [ u32 epoch ]                   # optional trailer
+
+The ``epoch`` trailer is the **membership epoch** of the sender's gang
+incarnation (``horovod_tpu.elastic``): each elastic re-form bumps it, and
+a receiver drops any list frame stamped with a different epoch — a stale
+in-flight frame from a previous incarnation (e.g. a zombie rank that was
+evicted but not dead) aborts deterministically instead of corrupting the
+new gang's negotiation.  It is a *trailer* so the layout stays
+backward/forward compatible: decoders that predate it (the C++ core
+before csrc/wire.cc grew the mirror) ignore trailing bytes, and a frame
+without the trailer decodes as epoch 0 — the only epoch the native
+engine may run at (elastic requires the Python engine).
 
 ``has_params`` carries the autotuner's knob broadcast (parity: rank 0
 tuning + Params bcast, ``parameter_manager.cc`` via ``controller.cc:33-47``);
@@ -124,7 +137,8 @@ def decode_request(data: bytes, off: int) -> Tuple[Request, int]:
 
 
 def encode_request_list(reqs: List[Request], shutdown: bool = False,
-                        cache_hits: List[Tuple[str, int]] = ()) -> bytes:
+                        cache_hits: List[Tuple[str, int]] = (),
+                        epoch: int = 0) -> bytes:
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(reqs))
     for r in reqs:
@@ -133,11 +147,13 @@ def encode_request_list(reqs: List[Request], shutdown: bool = False,
     for name, pos in cache_hits:
         _pack_str(buf, name)
         buf += struct.pack("<I", pos)
+    buf += struct.pack("<I", epoch)
     return bytes(buf)
 
 
 def decode_request_list(
-        data: bytes) -> Tuple[List[Request], bool, List[Tuple[str, int]]]:
+        data: bytes) -> Tuple[List[Request], bool, List[Tuple[str, int]],
+                              int]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
@@ -152,7 +168,10 @@ def decode_request_list(
         (pos,) = struct.unpack_from("<I", data, off)
         off += 4
         hits.append((name, pos))
-    return out, bool(shutdown), hits
+    epoch = 0
+    if off + 4 <= len(data):  # pre-trailer encoders stop here
+        (epoch,) = struct.unpack_from("<I", data, off)
+    return out, bool(shutdown), hits, epoch
 
 
 def encode_response(resp: Response, buf: bytearray) -> None:
@@ -234,8 +253,8 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
                          hit_positions: List[int] = (),
                          resend_names: List[str] = (),
                          params: Optional[Tuple[int, float, bool,
-                                                bool, bool]] = None
-                         ) -> bytes:
+                                                bool, bool]] = None,
+                         epoch: int = 0) -> bytes:
     """``params``: (fusion_threshold, cycle_time_s, cache_enabled,
     hierarchical_allreduce, hierarchical_allgather) knob broadcast from
     the autotuner, or None."""
@@ -256,12 +275,13 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
         buf += struct.pack("<BqdBBB", 1, fusion, cycle_s,
                            1 if cache_on else 0, 1 if hier_ar else 0,
                            1 if hier_ag else 0)
+    buf += struct.pack("<I", epoch)
     return bytes(buf)
 
 
 def decode_response_list(data: bytes) -> Tuple[
         List[Response], bool, List[int], List[str],
-        Optional[Tuple[int, float, bool, bool, bool]]]:
+        Optional[Tuple[int, float, bool, bool, bool]], int]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
@@ -290,4 +310,7 @@ def decode_response_list(data: bytes) -> Tuple[
         off += struct.calcsize("<qdBBB")
         params = (fusion, cycle_s, bool(cache_on), bool(hier_ar),
                   bool(hier_ag))
-    return out, bool(shutdown), hits, resend, params
+    epoch = 0
+    if off + 4 <= len(data):  # pre-trailer encoders stop here
+        (epoch,) = struct.unpack_from("<I", data, off)
+    return out, bool(shutdown), hits, resend, params, epoch

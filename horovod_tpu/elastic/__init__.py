@@ -1,0 +1,23 @@
+"""Elastic training: commit/rollback state, host discovery, and
+in-process gang re-form (docs/elastic.md).
+
+Parity: ``horovod.elastic`` — ``@hvd.elastic.run`` around a training
+function taking a :class:`State` first; on rank failure or host-set
+change the gang re-forms in process under a new membership epoch, the
+state rolls back to its last ``commit()`` and re-syncs from the new
+rank 0, and the function is invoked again.
+"""
+
+from horovod_tpu.elastic.driver import (  # noqa: F401
+    ElasticDriver,
+    FixedHostDiscovery,
+    HostDiscoveryScript,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.elastic.run import run  # noqa: F401
+from horovod_tpu.elastic.state import (  # noqa: F401
+    KerasState,
+    ObjectState,
+    State,
+    TorchState,
+)

@@ -1,0 +1,193 @@
+"""Host discovery + membership loop for elastic training.
+
+Parity: ``horovod/runner/elastic/discovery.py`` (HostDiscoveryScript and
+the HostManager polling loop inside ElasticDriver).  The driver runs on
+the coordinator side — inside ``hvdrun`` for launcher-managed elasticity,
+or inside the rank-0 process when a job opts in directly — and re-polls
+the host set on an interval:
+
+* ``--host-discovery-script`` / HVD_HOST_DISCOVERY_SCRIPT: an executable
+  printing one ``hostname[:slots]`` per line (the reference's contract),
+* the launcher's :class:`~horovod_tpu.runner.hosts.HostBlacklist` filters
+  hosts that recently killed workers,
+* TPU pod metadata (``runner/discovery.py``) seeds the initial host set
+  when no script is given.
+
+Each accepted membership change bumps the **membership epoch** — the
+integer stamped on every wire list frame (``common/wire.py``) and on the
+rendezvous scope, so each gang incarnation is isolated from the last.
+The in-process re-form protocol that consumes these epochs lives in
+``elastic/run.py``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+
+class HostsUpdatedInterrupt(Exception):
+    """The discovered host set changed: re-form the gang at a commit
+    point instead of waiting for a failure.  Raised by
+    ``State.commit()`` (all ranks raise in the same commit — see
+    ``state.check_host_updates``), caught by ``@hvd.elastic.run``."""
+
+    def __init__(self, res: Optional[dict] = None):
+        self.res = res or {}
+        super().__init__("host set updated; gang re-form requested")
+
+
+class HostDiscoveryScript:
+    """Runs a user script that prints ``hostname[:slots]`` per line.
+
+    Parity: ``horovod/runner/elastic/discovery.py`` HostDiscoveryScript.
+    A failing or hanging script yields the *previous* host set (the
+    driver keeps running on stale-but-sane data rather than evicting
+    everyone because discovery hiccupped).
+    """
+
+    def __init__(self, script: str, default_slots: int = 1,
+                 timeout_s: float = 30.0):
+        self.script = script
+        self.default_slots = default_slots
+        self.timeout_s = timeout_s
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(
+            self.script, shell=True, timeout=self.timeout_s)
+        hosts: Dict[str, int] = {}
+        for line in out.decode("utf-8").splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                host, slots = line.rsplit(":", 1)
+                hosts[host.strip()] = int(slots)
+            else:
+                hosts[line] = self.default_slots
+        return hosts
+
+
+class FixedHostDiscovery:
+    """Static host set (no script): the parsed ``-H`` list, or TPU pod
+    metadata (``TPU_WORKER_HOSTNAMES``) when available."""
+
+    def __init__(self, hosts: Optional[Dict[str, int]] = None):
+        if hosts is None:
+            hosts = {}
+            import os
+
+            names = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+            for h in names.split(","):
+                if h.strip():
+                    hosts[h.strip()] = 1
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class ElasticDriver:
+    """Membership loop: polls discovery, filters the blacklist, and bumps
+    the epoch on every accepted change.
+
+    ``on_hosts_updated(epoch, added, removed)`` fires from the poll
+    thread on each change; the launcher uses it to start workers on new
+    hosts, the in-process path to publish an update notice to the KV
+    store (``run.py``).
+    """
+
+    def __init__(self, discovery, min_np: int, max_np: int,
+                 blacklist=None, interval_s: Optional[float] = None,
+                 on_hosts_updated: Optional[Callable] = None):
+        self.discovery = discovery
+        self.min_np = min_np
+        self.max_np = max_np
+        self.blacklist = blacklist
+        self.interval_s = interval_s if interval_s is not None else \
+            env_util.get_float(env_util.ELASTIC_DISCOVERY_INTERVAL_S, 1.0)
+        self.on_hosts_updated = on_hosts_updated
+        self.log = get_logger(0)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._hosts: Dict[str, int] = {}
+        self._epoch = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- polling --------------------------------------------------------
+
+    def start(self) -> None:
+        self._poll_once()  # synchronous first poll: start() returns with
+        # a host set, so wait_for_available_slots has data immediately
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="hvd-elastic-driver", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 5)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._poll_once()
+
+    def _poll_once(self) -> None:
+        try:
+            found = self.discovery.find_available_hosts_and_slots()
+        except Exception as e:
+            self.log.warning("host discovery failed (%r); keeping the "
+                             "current host set", e)
+            return
+        if self.blacklist is not None:
+            found = {h: s for h, s in found.items()
+                     if not self.blacklist.is_blacklisted(h)}
+        with self._cv:
+            if found == self._hosts:
+                return
+            added = sorted(set(found) - set(self._hosts))
+            removed = sorted(set(self._hosts) - set(found))
+            self._hosts = found
+            self._epoch += 1
+            epoch = self._epoch
+            self._cv.notify_all()
+        self.log.info("host set changed (epoch %d): +%s -%s",
+                      epoch, added, removed)
+        if self.on_hosts_updated is not None:
+            self.on_hosts_updated(epoch, added, removed)
+
+    # -- queries --------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._hosts)
+
+    def slots(self) -> int:
+        with self._lock:
+            return sum(self._hosts.values())
+
+    def wait_for_available_slots(self, np: int,
+                                 timeout: float = 600.0) -> Dict[str, int]:
+        """Block until discovery reports at least ``np`` slots (the
+        reference blocks the same way before each (re)launch)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while sum(self._hosts.values()) < np:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"discovery found {sum(self._hosts.values())} "
+                        f"slot(s), need {np} (after {timeout:.0f}s)")
+                self._cv.wait(min(remaining, self.interval_s))
+            return dict(self._hosts)

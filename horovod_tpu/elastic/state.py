@@ -1,0 +1,254 @@
+"""Elastic training state: commit / rollback / sync.
+
+Parity: ``horovod/common/elastic.py`` (State, ObjectState) and the thin
+framework adapters in ``horovod/torch/elastic.py`` /
+``horovod/tensorflow/keras/elastic.py``.
+
+The contract ``@hvd.elastic.run`` relies on:
+
+* ``commit()`` — in-memory snapshot of the registered values, taken at a
+  point the training loop could restart from.  Called **collectively**
+  (same count on every rank): it also runs the host-update check, which
+  agrees via a 1-element MIN-allreduce so either every rank raises
+  :class:`~horovod_tpu.elastic.driver.HostsUpdatedInterrupt` at the same
+  commit or none does — a lone rank interrupting would strand the others
+  in a collective.
+* ``restore()`` — roll back to the last commit.  After a rank failure the
+  survivors may have half-applied a step whose allreduce completed with
+  zero stand-ins; rolling back to the commit makes the re-formed gang
+  bit-consistent again.
+* ``sync(root=0)`` — broadcast the state from ``root``.  The re-form
+  protocol orders survivors by old rank, so new rank 0 is the lowest
+  surviving committed rank — the canonical source.  Joiners receive the
+  whole state here, which is what makes growth checkpoint-free.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class State:
+    """Base elastic state; subclasses define what save/restore/sync move.
+
+    ``register_reset_callbacks``: hooks run after every gang re-form
+    (new world size — re-partition data, rescale the learning rate...).
+    """
+
+    def __init__(self):
+        self._reset_callbacks: List[Callable] = []
+        # Attached by @hvd.elastic.run; None outside an elastic wrapper
+        # (commit() then degrades to a plain snapshot).
+        self._elastic_ctx = None
+        self._commit_serial = 0
+        self._last_host_poll = 0.0
+        self._update_pending = False
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        # Commit-check collectives are named by this serial; every member
+        # of the re-formed gang must agree on it, and a freshly admitted
+        # joiner starts at 0 — so survivors rewind theirs too.
+        self._commit_serial = 0
+        self._last_host_poll = 0.0
+        self._update_pending = False
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def reset(self) -> None:  # subclass hook
+        pass
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self, root: int = 0) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise ``HostsUpdatedInterrupt`` once every rank has seen a
+        pending membership update (joiner announcement or discovery
+        change published to the KV store by the driver)."""
+        ctx = self._elastic_ctx
+        if ctx is None:
+            return
+        from horovod_tpu.elastic.driver import HostsUpdatedInterrupt
+        from horovod_tpu.ops import eager
+
+        now = time.monotonic()
+        if not self._update_pending and \
+                now - self._last_host_poll >= ctx.check_interval_s:
+            self._last_host_poll = now
+            self._update_pending = ctx.has_pending_update()
+        # Collective agreement: MIN over "I have seen the update" — 1 on
+        # every rank only when all have, so all interrupt together.
+        self._commit_serial += 1
+        flag = np.array([1 if self._update_pending else 0], np.int32)
+        agreed = eager.allreduce(
+            flag, op=eager.ReduceOp.MIN,
+            name=f"elastic.commit_check.{self._commit_serial}")
+        if int(agreed[0]) >= 1:
+            self._update_pending = False
+            raise HostsUpdatedInterrupt()
+
+
+def _snapshot(value):
+    if isinstance(value, np.ndarray):
+        return value.copy()
+    return copy.deepcopy(value)
+
+
+class ObjectState(State):
+    """State over arbitrary attributes (pytrees, arrays, scalars).
+
+    ``ObjectState(model=params, optimizer=opt_state, batch=0, epoch=0)``
+    exposes each kwarg as an attribute; save/restore/sync move all of
+    them.  Parity: ``horovod/common/elastic.py`` ObjectState.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._known = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._saved = {}
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: _snapshot(getattr(self, k)) for k in self._known}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, _snapshot(v))
+
+    def sync(self, root: int = 0) -> None:
+        from horovod_tpu.ops import eager
+
+        values = {k: getattr(self, k) for k in self._known}
+        synced = eager.broadcast_object(values, root_rank=root,
+                                        name="elastic.state")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class TorchState(State):
+    """Elastic state over a torch ``model``/``optimizer`` pair (thin
+    adapter; parity: ``horovod/torch/elastic/state.py``).  Extra kwargs
+    ride along as an embedded :class:`ObjectState`."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        try:
+            import torch  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "TorchState requires torch; use ObjectState for "
+                "framework-agnostic pytrees") from e
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._extra = ObjectState(**kwargs) if kwargs else None
+        self._saved_model = None
+        self._saved_opt = None
+        self.save()
+
+    def __getattr__(self, name):
+        extra = self.__dict__.get("_extra")
+        if extra is not None and name in extra._known:
+            return getattr(extra, name)
+        raise AttributeError(name)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._saved_model = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        if self._extra is not None:
+            self._extra.save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_model is not None:
+            self.model.load_state_dict(copy.deepcopy(self._saved_model))
+        if self.optimizer is not None and self._saved_opt is not None:
+            self.optimizer.load_state_dict(copy.deepcopy(self._saved_opt))
+        if self._extra is not None:
+            self._extra.restore()
+
+    def sync(self, root: int = 0) -> None:
+        from horovod_tpu.ops import eager
+
+        if self.model is not None:
+            sd = eager.broadcast_object(self.model.state_dict(),
+                                        root_rank=root,
+                                        name="elastic.torch.model")
+            self.model.load_state_dict(sd)
+        if self.optimizer is not None:
+            sd = eager.broadcast_object(self.optimizer.state_dict(),
+                                        root_rank=root,
+                                        name="elastic.torch.opt")
+            self.optimizer.load_state_dict(sd)
+        if self._extra is not None:
+            self._extra.sync(root)
+        self.save()
+
+
+class KerasState(State):
+    """Elastic state over a Keras ``model`` (weights move as numpy via
+    ``get_weights``/``set_weights``); parity:
+    ``horovod/tensorflow/keras/elastic.py``."""
+
+    def __init__(self, model=None, **kwargs):
+        if model is not None and not (hasattr(model, "get_weights")
+                                      and hasattr(model, "set_weights")):
+            raise TypeError(
+                "KerasState needs a model with get_weights/set_weights")
+        super().__init__()
+        self.model = model
+        self._extra = ObjectState(**kwargs) if kwargs else None
+        self._saved_weights: Optional[list] = None
+        self.save()
+
+    def __getattr__(self, name):
+        extra = self.__dict__.get("_extra")
+        if extra is not None and name in extra._known:
+            return getattr(extra, name)
+        raise AttributeError(name)
+
+    def save(self) -> None:
+        if self.model is not None:
+            self._saved_weights = [np.array(w)
+                                   for w in self.model.get_weights()]
+        if self._extra is not None:
+            self._extra.save()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_weights is not None:
+            self.model.set_weights([w.copy()
+                                    for w in self._saved_weights])
+        if self._extra is not None:
+            self._extra.restore()
+
+    def sync(self, root: int = 0) -> None:
+        from horovod_tpu.ops import eager
+
+        if self.model is not None:
+            weights = eager.broadcast_object(self.model.get_weights(),
+                                             root_rank=root,
+                                             name="elastic.keras.model")
+            self.model.set_weights(weights)
+        if self._extra is not None:
+            self._extra.sync(root)
+        self.save()

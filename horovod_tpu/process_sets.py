@@ -66,7 +66,15 @@ class ProcessSet:
             prev = _registry.get(self.process_set_id)
             if prev is not None and prev != members:
                 raise ValueError(
-                    f"process-set id collision: {members} vs {prev}")
+                    f"process-set id collision: ranks {members} hash to "
+                    f"id {self.process_set_id}, already registered for "
+                    f"ranks {prev}.  Set ids are a 31-bit hash of the "
+                    "member list, so distinct sets can (rarely) collide; "
+                    "requests would be routed to the wrong subgroup.  "
+                    "Re-partition one of the two subgroups (any change "
+                    "to its member list picks a new id), or call "
+                    "process_sets.reset() if the colliding set belongs "
+                    "to a previous world that no longer exists.")
             _registry[self.process_set_id] = members
         # The native engine keeps its own registry (the C++ coordinator
         # and the skip path consult it); tell it about this set if it is
@@ -121,6 +129,8 @@ def snapshot() -> Dict[int, List[int]]:
 
 
 def reset() -> None:
-    """Testing hook: forget all registered sets."""
+    """Forget all registered sets.  Called by the elastic re-form path
+    (ranks are renumbered, so old member lists are meaningless) and by
+    tests."""
     with _lock:
         _registry.clear()

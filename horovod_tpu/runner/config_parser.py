@@ -28,6 +28,9 @@ _ARG_ENV = {
     "autotune_log_file": E.AUTOTUNE_LOG,
     "adasum_mode": E.ADASUM_MODE,
     "log_level": "HVD_LOG_LEVEL",
+    "min_np": E.ELASTIC_MIN_NP,
+    "max_np": E.ELASTIC_MAX_NP,
+    "host_discovery_script": E.HOST_DISCOVERY_SCRIPT,
 }
 
 _MB = {"fusion_threshold_mb"}

@@ -50,15 +50,17 @@ class KVClient:
         self.retry_base = env_util.get_float("HVD_KV_RETRY_BASE_S", 0.05)
         self.retry_max = env_util.get_float("HVD_KV_RETRY_MAX_S", 2.0)
 
-    def _url(self, key: str) -> str:
-        return f"http://{self.host}:{self.port}/kv/{key}"
+    def _url(self, path: str) -> str:
+        return f"http://{self.host}:{self.port}{path}"
 
-    def _request(self, key: str, method: str, body: Optional[bytes] = None):
-        req = urllib.request.Request(self._url(key), data=body,
+    def _request(self, key: str, method: str, body: Optional[bytes] = None,
+                 endpoint: str = "/kv/"):
+        path = f"{endpoint}{key}"
+        req = urllib.request.Request(self._url(path), data=body,
                                      method=method)
         if self.secret is not None:
             req.add_header(secret_mod.HEADER, secret_mod.sign(
-                self.secret, method, f"/kv/{key}", body or b""))
+                self.secret, method, path, body or b""))
         return req
 
     def _with_retry(self, fn, site: str, key: str):
@@ -99,6 +101,17 @@ class KVClient:
                 raise
 
         return self._with_retry(go, "kv.get", key)
+
+    def list(self, prefix: str) -> list:
+        """Keys currently stored under ``prefix``, sorted."""
+        def go():
+            with urllib.request.urlopen(
+                    self._request(prefix, "GET", endpoint="/kvlist/"),
+                    timeout=self.timeout) as r:
+                body = r.read().decode("utf-8")
+            return body.split("\n") if body else []
+
+        return self._with_retry(go, "kv.list", prefix)
 
     def delete(self, key: str) -> None:
         def go():

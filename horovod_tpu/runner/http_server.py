@@ -5,7 +5,9 @@ KVStoreServer: a scoped PUT/GET/DELETE key-value store that workers use to
 exchange addresses at startup and to return run-function results).
 
 Protocol: ``PUT /kv/<key>`` stores the body; ``GET /kv/<key>`` returns it or
-404; ``DELETE /kv/<key>`` removes it; ``GET /health`` returns ``ok``.
+404; ``DELETE /kv/<key>`` removes it; ``GET /kvlist/<prefix>`` returns the
+matching keys, newline-separated (the elastic driver enumerates pending
+joiners this way); ``GET /health`` returns ``ok``.
 
 When the server holds a job secret (parity: ``run/common/util/secret.py``
 HMAC framing), every ``/kv/`` request must carry a valid
@@ -71,6 +73,17 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if not self._authorized():
             self._reject()
+            return
+        if self.path.startswith("/kvlist/"):
+            prefix = self.path[len("/kvlist/"):]
+            with self.server.kv_lock:  # type: ignore[attr-defined]
+                keys = sorted(k for k in self._store()
+                              if k.startswith(prefix))
+            body = "\n".join(keys).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         with self.server.kv_lock:  # type: ignore[attr-defined]

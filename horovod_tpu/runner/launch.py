@@ -116,6 +116,54 @@ class LaunchError(RuntimeError):
         self.hostname = hostname
 
 
+def _spawn_worker(
+    slot: SlotInfo,
+    command: Sequence[str],
+    rdv_addr: str,
+    rdv_port: int,
+    env_extra: Optional[Dict[str, str]],
+    ssh_port: Optional[int],
+    ssh_identity_file: Optional[str],
+    output,
+    prefix_output: bool,
+):
+    """Start one worker (local exec or ssh) with its streaming thread."""
+    env = worker_env(slot, rdv_addr, rdv_port, env_extra)
+    stdin_payload = None
+    if is_local(slot.hostname):
+        argv = list(command)
+        popen_env = env
+    else:
+        # -tt forces a remote pty so killing the local ssh client
+        # HUPs the remote process group — fail-fast teardown reaches
+        # remote workers, not just the local ssh processes.
+        ssh_cmd = ["ssh", "-tt", "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh_cmd += ["-p", str(ssh_port)]
+        if ssh_identity_file:
+            ssh_cmd += ["-i", ssh_identity_file]
+        # Only HVD_* vars cross the ssh boundary (the reference passes
+        # an explicit env list too, mpi_run.py -x); secrets go over
+        # stdin, never argv.
+        remote, stdin_payload = _remote_command(env, command)
+        argv = ssh_cmd + [slot.hostname, remote]
+        popen_env = dict(os.environ)
+    proc = subprocess.Popen(
+        argv, env=popen_env,
+        stdin=subprocess.PIPE if stdin_payload else None,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, start_new_session=True)
+    if stdin_payload:
+        proc.stdin.write(stdin_payload.encode())
+        proc.stdin.flush()
+        proc.stdin.close()
+    t = threading.Thread(target=_stream,
+                         args=(proc, slot.rank, output, prefix_output),
+                         daemon=True)
+    t.start()
+    return proc, t
+
+
 def launch_workers(
     slots: Sequence[SlotInfo],
     command: Sequence[str],
@@ -140,40 +188,10 @@ def launch_workers(
     threads: List[threading.Thread] = []
 
     for slot in slots:
-        env = worker_env(slot, rdv_addr, rdv_port, env_extra)
-        stdin_payload = None
-        if is_local(slot.hostname):
-            argv = list(command)
-            popen_env = env
-        else:
-            # -tt forces a remote pty so killing the local ssh client
-            # HUPs the remote process group — fail-fast teardown reaches
-            # remote workers, not just the local ssh processes.
-            ssh_cmd = ["ssh", "-tt", "-o", "StrictHostKeyChecking=no"]
-            if ssh_port:
-                ssh_cmd += ["-p", str(ssh_port)]
-            if ssh_identity_file:
-                ssh_cmd += ["-i", ssh_identity_file]
-            # Only HVD_* vars cross the ssh boundary (the reference passes
-            # an explicit env list too, mpi_run.py -x); secrets go over
-            # stdin, never argv.
-            remote, stdin_payload = _remote_command(env, command)
-            argv = ssh_cmd + [slot.hostname, remote]
-            popen_env = dict(os.environ)
-        proc = subprocess.Popen(
-            argv, env=popen_env,
-            stdin=subprocess.PIPE if stdin_payload else None,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, start_new_session=True)
-        if stdin_payload:
-            proc.stdin.write(stdin_payload.encode())
-            proc.stdin.flush()
-            proc.stdin.close()
+        proc, t = _spawn_worker(slot, command, rdv_addr, rdv_port,
+                                env_extra, ssh_port, ssh_identity_file,
+                                output, prefix_output)
         procs.append(proc)
-        t = threading.Thread(target=_stream,
-                             args=(proc, slot.rank, output, prefix_output),
-                             daemon=True)
-        t.start()
         threads.append(t)
 
     failure: Optional[LaunchError] = None
@@ -200,6 +218,104 @@ def launch_workers(
         p.wait()
     for t in threads:
         t.join(timeout=2)
+
+
+def launch_workers_elastic(
+    slots: Sequence[SlotInfo],
+    command: Sequence[str],
+    rdv_addr: str,
+    rdv_port: int,
+    *,
+    min_np: int,
+    max_np: int,
+    env_extra: Optional[Dict[str, str]] = None,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+    prefix_output: bool = True,
+    output=None,
+    kill_timeout: float = 5.0,
+    new_slots: Optional[Callable[[], List[SlotInfo]]] = None,
+    on_failure: Optional[Callable[[str], None]] = None,
+) -> None:
+    """Elastic supervision: a dying worker does NOT kill the job.
+
+    The in-process gang re-forms around failures (``elastic/run.py``),
+    so the launcher's job is only to (a) keep supervising survivors,
+    (b) spawn joiner processes on hosts ``new_slots()`` reports (fed by
+    the discovery driver), capped at ``max_np`` live workers, and
+    (c) declare the job failed only when fewer than ``min_np`` workers
+    finished cleanly — the same floor the gang itself enforces.
+
+    ``on_failure(hostname)`` fires per non-zero exit (blacklist feed).
+    Joiners still pending once every original worker has exited are
+    torn down and not counted as failures.
+    """
+    output = output or sys.stdout
+    entries: List[dict] = []
+
+    def _spawn(slot: SlotInfo, joiner: bool) -> None:
+        extra = dict(env_extra or {})
+        if joiner:
+            extra["HVD_ELASTIC_JOINER"] = "1"
+        proc, t = _spawn_worker(slot, command, rdv_addr, rdv_port,
+                                extra, ssh_port, ssh_identity_file,
+                                output, prefix_output)
+        entries.append({"slot": slot, "proc": proc, "thread": t,
+                        "joiner": joiner, "rc": None})
+
+    for slot in slots:
+        _spawn(slot, joiner=False)
+
+    successes = 0
+    first_failure: Optional[LaunchError] = None
+    while True:
+        live = [e for e in entries if e["rc"] is None]
+        if not live:
+            break
+        for e in live:
+            rc = e["proc"].poll()
+            if rc is None:
+                continue
+            e["rc"] = rc
+            if rc == 0:
+                successes += 1
+            else:
+                slot = e["slot"]
+                if first_failure is None:
+                    first_failure = LaunchError(slot.rank, rc,
+                                                hostname=slot.hostname)
+                if on_failure is not None:
+                    on_failure(slot.hostname)
+                print(f"hvdrun: worker rank {slot.rank} on "
+                      f"{slot.hostname} exited with code {rc}; the gang "
+                      "re-forms in process (elastic mode)",
+                      file=sys.stderr)
+        originals_done = all(e["rc"] is not None for e in entries
+                             if not e["joiner"])
+        if originals_done:
+            # Nobody left to admit a pending joiner — reap stragglers.
+            stragglers = [e["proc"] for e in entries
+                          if e["joiner"] and e["rc"] is None]
+            if stragglers:
+                _terminate(stragglers, kill_timeout)
+                for e in entries:
+                    if e["joiner"] and e["rc"] is None:
+                        e["rc"] = e["proc"].poll()
+            break
+        if new_slots is not None:
+            live_count = sum(1 for e in entries if e["rc"] is None)
+            for slot in new_slots():
+                if live_count >= max_np:
+                    break
+                _spawn(slot, joiner=True)
+                live_count += 1
+        time.sleep(0.05)
+
+    for e in entries:
+        e["thread"].join(timeout=2)
+    if successes < min_np:
+        raise first_failure if first_failure is not None else LaunchError(
+            slots[0].rank if slots else 0, 1)
 
 
 def _terminate(procs: List[subprocess.Popen], kill_timeout: float) -> None:

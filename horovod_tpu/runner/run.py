@@ -179,6 +179,28 @@ def make_parser() -> argparse.ArgumentParser:
                         "the TPU-native form of elastic training — pod "
                         "meshes restart, they do not re-form). Default "
                         "0 keeps the reference's fail-fast contract.")
+    el = p.add_argument_group(
+        "elastic",
+        "in-process elasticity (docs/elastic.md): ranks may die or join "
+        "without relaunching the job — survivors roll back to the last "
+        "State.commit() and re-form under a new membership epoch.  "
+        "Requires the Python engine (set automatically) and a training "
+        "script wrapped in @hvd.elastic.run.  Composes with "
+        "--max-restarts as the outer fallback: a gang that collapses "
+        "below --min-np is relaunched whole.")
+    el.add_argument("--min-np", type=int, dest="min_np",
+                    help="keep going while at least this many workers "
+                         "survive (default: -np)")
+    el.add_argument("--max-np", type=int, dest="max_np",
+                    help="admit joiners up to this many workers "
+                         "(default: -np, i.e. no growth headroom)")
+    el.add_argument("--host-discovery-script",
+                    dest="host_discovery_script",
+                    help="executable printing one 'hostname[:slots]' per "
+                         "line; re-polled by the launcher, which starts "
+                         "joiner workers on newly discovered hosts "
+                         "(absent: membership only shrinks in process, "
+                         "and --max-restarts covers full relaunches)")
     p.add_argument("--disable-cache", action="store_true",
                    dest="disable_cache")
     p.add_argument("--output-filename", dest="output_filename")
@@ -260,6 +282,41 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               "the job lifecycle; use its requeue policy)",
               file=sys.stderr)
         return 2
+    # Elastic flags: validate at parse time, before any rendezvous/ssh
+    # side effects — a bad floor/ceiling or a missing discovery script
+    # must fail in milliseconds, not mid-launch.
+    elastic = (args.min_np is not None or args.max_np is not None
+               or args.host_discovery_script is not None)
+    min_np = args.min_np if args.min_np is not None else args.np
+    max_np = args.max_np if args.max_np is not None else args.np
+    if elastic:
+        if args.launcher in ("jsrun", "mpirun"):
+            print(f"{_prog_name()}: elastic flags (--min-np/--max-np/"
+                  "--host-discovery-script) are not supported with "
+                  f"--launcher {args.launcher} (the external scheduler "
+                  "owns process placement; elastic needs the spawn "
+                  "launcher's supervision loop)", file=sys.stderr)
+            return 2
+        if min_np < 1:
+            print(f"{_prog_name()}: --min-np must be >= 1 "
+                  f"(got {min_np})", file=sys.stderr)
+            return 2
+        if min_np > args.np:
+            print(f"{_prog_name()}: --min-np ({min_np}) cannot exceed "
+                  f"-np ({args.np}) — the job starts at -np workers and "
+                  "shrinks from there", file=sys.stderr)
+            return 2
+        if max_np < args.np:
+            print(f"{_prog_name()}: --max-np ({max_np}) cannot be below "
+                  f"-np ({args.np}) — the job starts at -np workers and "
+                  "grows from there", file=sys.stderr)
+            return 2
+        script = args.host_discovery_script
+        if script and not (os.path.isfile(script)
+                           and os.access(script, os.X_OK)):
+            print(f"{_prog_name()}: --host-discovery-script {script!r} "
+                  "is not an executable file", file=sys.stderr)
+            return 2
     mpi_impl = None
     if args.launcher == "mpirun":
         # Probe before any rendezvous/ssh side effects: a missing
@@ -383,10 +440,26 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                     ssh_identity_file=args.ssh_identity_file)
             return subprocess.run(
                 cmd, env=env, stdout=output or None).returncode
-        from horovod_tpu.runner.hosts import HostBlacklist
-        from horovod_tpu.runner.launch import LaunchError
+        from horovod_tpu.runner.hosts import HostBlacklist, SlotInfo
+        from horovod_tpu.runner.launch import (
+            LaunchError,
+            launch_workers_elastic,
+        )
+        from horovod_tpu.utils import env as E
 
-        blacklist = HostBlacklist() if args.max_restarts else None
+        blacklist = HostBlacklist() if (args.max_restarts or elastic) \
+            else None
+        if elastic:
+            env_extra[E.ELASTIC_MIN_NP] = str(min_np)
+            env_extra[E.ELASTIC_MAX_NP] = str(max_np)
+            env_extra[E.ELASTIC_EPOCH] = "0"
+            # The native engine has no in-process reset path; elastic
+            # jobs always run the Python engine.
+            env_extra["HVD_TPU_CORE"] = "py"
+            # The launcher owns the discovery loop (it must spawn joiner
+            # processes on the new hosts); don't also start a notifier
+            # driver inside rank 0 — joiners announce themselves.
+            env_extra.pop(E.HOST_DISCOVERY_SCRIPT, None)
         for attempt in range(args.max_restarts + 1):
             env_try = dict(env_extra)
             if attempt:
@@ -403,13 +476,70 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                           f"host(s) {', '.join(skipped)} on relaunch",
                           file=sys.stderr)
                 slots = allocate(use_hosts, args.np)
+            driver = None
             try:
-                launch_workers(
-                    slots, command, addr, port,
-                    env_extra=env_try,
-                    ssh_port=args.ssh_port,
-                    ssh_identity_file=args.ssh_identity_file,
-                    output=output)
+                if elastic:
+                    take_pending = None
+                    if args.host_discovery_script:
+                        import threading
+
+                        from horovod_tpu.elastic.driver import (
+                            ElasticDriver,
+                            HostDiscoveryScript,
+                        )
+
+                        lock = threading.Lock()
+                        known = {s.hostname for s in slots}
+                        pending: List[SlotInfo] = []
+                        next_rank = [len(slots)]
+
+                        def on_update(ep, added, removed):
+                            # Queue joiner slots for each genuinely new
+                            # host; the supervision loop spawns them.
+                            found = driver.hosts()
+                            with lock:
+                                for h in added:
+                                    if h in known:
+                                        continue
+                                    known.add(h)
+                                    n = found.get(h, 1)
+                                    for li in range(n):
+                                        pending.append(SlotInfo(
+                                            hostname=h,
+                                            rank=next_rank[0],
+                                            size=0, local_rank=li,
+                                            local_size=n,
+                                            cross_rank=0, cross_size=0))
+                                        next_rank[0] += 1
+
+                        def take_pending():
+                            with lock:
+                                out = list(pending)
+                                pending.clear()
+                            return out
+
+                        driver = ElasticDriver(
+                            HostDiscoveryScript(
+                                args.host_discovery_script),
+                            min_np, max_np, blacklist=blacklist,
+                            on_hosts_updated=on_update)
+                        driver.start()
+                    launch_workers_elastic(
+                        slots, command, addr, port,
+                        min_np=min_np, max_np=max_np,
+                        env_extra=env_try,
+                        ssh_port=args.ssh_port,
+                        ssh_identity_file=args.ssh_identity_file,
+                        output=output,
+                        new_slots=take_pending,
+                        on_failure=blacklist.record_failure)
+                else:
+                    launch_workers(
+                        slots, command, addr, port,
+                        env_extra=env_try,
+                        ssh_port=args.ssh_port,
+                        ssh_identity_file=args.ssh_identity_file,
+                        output=output)
                 return 0
             except LaunchError as e:
                 if blacklist is not None:
@@ -422,6 +552,9 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                       + f"; restarting the job "
                       f"(attempt {attempt + 1}/{args.max_restarts})",
                       file=sys.stderr)
+            finally:
+                if driver is not None:
+                    driver.stop()
         raise AssertionError("unreachable: loop returns or raises")
     finally:
         if output is not None:

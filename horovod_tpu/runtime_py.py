@@ -322,6 +322,11 @@ class PyEngine(_EngineBase):
         self.hierarchical_allgather = env_util.get_bool(
             env_util.HIERARCHICAL_ALLGATHER, False)
         self.native_fallback_reason = None
+        # Elastic membership epoch (horovod_tpu.elastic): stamped on every
+        # list frame; frames from another incarnation are dropped (worker)
+        # or rejected (coordinator) so a zombie rank from a previous gang
+        # cannot corrupt this one's negotiation.
+        self.epoch = env_util.get_int(env_util.ELASTIC_EPOCH, 0)
 
         # request queue (tensor queue) + tensor table
         self._queue_lock = threading.Lock()
@@ -342,6 +347,7 @@ class PyEngine(_EngineBase):
         self._loop_exited = threading.Event()
         self._closed = False  # shutdown() ran its cleanup (socket close)
         self._aborted = False
+        self._abort_reason = None
 
         # coordinator state
         self._msg_table = _MessageTable(size) if rank == 0 else None
@@ -389,6 +395,10 @@ class PyEngine(_EngineBase):
         self._pending_params = None
 
         self._bootstrap(rdv_addr, rdv_port)
+
+        if self.epoch and self.timeline.enabled:
+            self.timeline.elastic_event(f"ELASTIC_EPOCH_{self.epoch}",
+                                        size=self.size)
 
         self._bg = threading.Thread(
             target=self._background_loop, name="hvd-background", daemon=True)
@@ -768,7 +778,8 @@ class PyEngine(_EngineBase):
         if requests or hit_events or want_shutdown:
             payload = wire.encode_request_list(requests,
                                                shutdown=want_shutdown,
-                                               cache_hits=hit_events)
+                                               cache_hits=hit_events,
+                                               epoch=self.epoch)
             try:
                 _fi.fire("ctrl.worker.send", str(self.rank))
                 su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
@@ -792,8 +803,16 @@ class PyEngine(_EngineBase):
             inbox = self._response_inbox
             self._response_inbox = []
         for payload in inbox:
-            responses, shutdown, hit_positions, resend, params = \
+            responses, shutdown, hit_positions, resend, params, epoch = \
                 wire.decode_response_list(payload)
+            if epoch != self.epoch:
+                # Stale incarnation (a coordinator we were re-formed away
+                # from, or one we have not re-formed to yet): executing
+                # its responses would desync this gang.  Drop the frame.
+                self.log.warning(
+                    "dropping response frame from epoch %d (ours: %d)",
+                    epoch, self.epoch)
+                continue
             if params is not None:
                 # Apply BEFORE executing this frame's hits: the fusion
                 # threshold shapes the fused launches, which must be
@@ -884,8 +903,18 @@ class PyEngine(_EngineBase):
             inbox = self._ctrl_inbox
             self._ctrl_inbox = []
         for peer, payload in inbox:
-            reqs, peer_shutdown, peer_hits = \
+            reqs, peer_shutdown, peer_hits, peer_epoch = \
                 wire.decode_request_list(payload)
+            if peer_epoch != self.epoch:
+                # A zombie from a previous incarnation (evicted but not
+                # dead, now reconnected through a stale socket): absorbing
+                # its requests would hang or corrupt this gang's
+                # negotiation — reject the frame before it touches the
+                # message table.
+                self.log.warning(
+                    "rejecting request frame from rank %d at epoch %d "
+                    "(ours: %d)", peer, peer_epoch, self.epoch)
+                continue
             shutdown = shutdown or peer_shutdown
             for req in reqs:
                 _absorb(req)
@@ -961,12 +990,13 @@ class PyEngine(_EngineBase):
                     payload = wire.encode_response_list(
                         fused, shutdown=shutdown,
                         hit_positions=hit_positions, resend_names=resend,
-                        params=params)
+                        params=params, epoch=self.epoch)
                 else:
                     if shared is None:
                         shared = wire.encode_response_list(
                             fused, shutdown=shutdown,
-                            hit_positions=hit_positions, params=params)
+                            hit_positions=hit_positions, params=params,
+                            epoch=self.epoch)
                     payload = shared
                 try:
                     _fi.fire("ctrl.coord.send", str(r))
@@ -1349,4 +1379,7 @@ class PyEngine(_EngineBase):
 
     def _abort(self, reason: str) -> None:
         self._aborted = True
+        # Recorded for the elastic wrapper: a lost-coordinator abort on a
+        # worker means rank 0 failed, which re-forms instead of exiting.
+        self._abort_reason = reason
         self._shutdown_flag.set()

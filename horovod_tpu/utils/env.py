@@ -39,6 +39,20 @@ KV_RETRY_MAX_S = "HVD_KV_RETRY_MAX_S"
 # Launcher host blacklist (relaunch path).
 BLACKLIST_THRESHOLD = "HVD_BLACKLIST_THRESHOLD"
 BLACKLIST_COOLDOWN_S = "HVD_BLACKLIST_COOLDOWN_S"
+# Elastic training (horovod_tpu.elastic; docs/elastic.md).  EPOCH is the
+# gang's membership incarnation (stamped on every wire list frame);
+# MIN_NP/MAX_NP bound the re-formed world; JOINER marks a late worker
+# that waits for an epoch assignment instead of bootstrapping at rank 0;
+# UID is a stable worker identity across incarnations; the two intervals
+# pace the commit-time membership check and the driver's discovery poll.
+ELASTIC_EPOCH = "HVD_ELASTIC_EPOCH"
+ELASTIC_MIN_NP = "HVD_ELASTIC_MIN_NP"
+ELASTIC_MAX_NP = "HVD_ELASTIC_MAX_NP"
+ELASTIC_JOINER = "HVD_ELASTIC_JOINER"
+ELASTIC_UID = "HVD_ELASTIC_UID"
+ELASTIC_CHECK_INTERVAL_S = "HVD_ELASTIC_CHECK_INTERVAL_S"
+ELASTIC_DISCOVERY_INTERVAL_S = "HVD_ELASTIC_DISCOVERY_INTERVAL_S"
+HOST_DISCOVERY_SCRIPT = "HVD_HOST_DISCOVERY_SCRIPT"
 
 
 def get_bool(name: str, default: bool = False) -> bool:

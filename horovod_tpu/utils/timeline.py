@@ -37,6 +37,13 @@ CPU_RING_ALLREDUCE = "CPU_RING_ALLREDUCE"
 XLA_ALLREDUCE = "XLA_ALLREDUCE"
 CYCLE_START = "CYCLE_START"
 
+# Live timelines by path: an elastic reset tears the engine down and
+# re-initializes it in the SAME process, and the new engine must append
+# to the trace instead of truncating it — the reset/re-form cycle being
+# visible in one file is the point of recording it.
+_live: dict = {}
+_live_lock = threading.Lock()
+
 
 class Timeline:
     """Per-process timeline; no-op unless ``initialize`` is called with a
@@ -49,15 +56,21 @@ class Timeline:
         self._start_ns = 0
         self._tensor_tids = {}
         self._mark_cycles = False
+        self._persistent = False  # survive engine shutdown (elastic)
 
     @property
     def enabled(self) -> bool:
         return self._q is not None
 
-    def initialize(self, filename: str, mark_cycles: bool = False) -> None:
+    def initialize(self, filename: str, mark_cycles: bool = False,
+                   persistent: bool = False) -> None:
         if self.enabled or not filename:
             return
-        self._f = open(filename, "w")
+        self._persistent = persistent
+        # Persistent (elastic) traces append: after a gang re-form, the
+        # new lowest-rank process may be one that never wrote the file —
+        # "w" would erase the pre-reset history.
+        self._f = open(filename, "a" if persistent else "w")
         self._f.write("[\n")
         self._start_ns = time.monotonic_ns()
         self._mark_cycles = mark_cycles
@@ -68,6 +81,12 @@ class Timeline:
 
     def shutdown(self) -> None:
         if not self.enabled:
+            return
+        if self._persistent:
+            # An elastic engine shutdown is not the end of the story —
+            # the re-formed engine re-attaches via from_env().  Events
+            # are flushed as they drain, so there is nothing to lose if
+            # the process exits instead.
             return
         self._q.put(None)
         self._writer.join(timeout=5)
@@ -133,6 +152,12 @@ class Timeline:
         if self._mark_cycles:
             self._emit("i", CYCLE_START, "")
 
+    def elastic_event(self, name: str, **args) -> None:
+        """Instant marker for the elastic reset/re-form cycle
+        (``ELASTIC_RESET`` / ``ELASTIC_REFORM`` / ``ELASTIC_EPOCH_<n>``),
+        on the process lane (tid 0) since it is not tied to a tensor."""
+        self._emit("i", name, "", args=args or None)
+
     # -- writer thread ----------------------------------------------------
 
     def _drain(self) -> None:
@@ -145,8 +170,21 @@ class Timeline:
 
 
 def from_env(rank: int) -> Timeline:
-    t = Timeline()
     path = os.environ.get("HVD_TIMELINE", "")
+    elastic = bool(os.environ.get("HVD_ELASTIC_EPOCH", ""))
+    if path and rank == 0 and elastic:
+        # Elastic: re-attach to the live timeline across engine
+        # resets in this process; the trace file spans epochs.
+        with _live_lock:
+            t = _live.get(path)
+            if t is None or not t.enabled:
+                t = Timeline()
+                t.initialize(path, mark_cycles=os.environ.get(
+                    "HVD_TIMELINE_MARK_CYCLES", "0") in ("1", "true"),
+                    persistent=True)
+                _live[path] = t
+        return t
+    t = Timeline()
     if path and rank == 0:
         t.initialize(path, mark_cycles=os.environ.get(
             "HVD_TIMELINE_MARK_CYCLES", "0") in ("1", "true"))

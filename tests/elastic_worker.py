@@ -1,0 +1,73 @@
+"""Elastic-scenario worker for tests/test_elastic.py.
+
+One process = one gang member running a training loop under
+``@hvd.elastic.run``.  A late joiner is the same script launched with
+``HVD_ELASTIC_JOINER=1``: the wrapper blocks it for an epoch assignment
+instead of bootstrapping the epoch-0 mesh.
+
+Markers are printed with ``flush=True`` so the driving test can parse
+them even when a rank dies abruptly:
+
+* ``STEP <i> <sum>`` — allreduce result for step ``i``.  A step printed
+  at the full-gang sum and again at the survivor-gang sum is the
+  rollback + replay proof.
+* ``RESET size <n>`` — a registered reset callback ran after a re-form.
+* ``FINAL_W <v>`` / ``FINAL_EPOCH <e>`` / ``DONE`` — loop completion.
+
+Exit codes: 0 scenario complete, 137 killed by an injected fault.
+"""
+
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection as fi
+
+    total = int(os.environ.get("ELASTIC_TOTAL_STEPS", "8"))
+    commit_every = int(os.environ.get("ELASTIC_COMMIT_EVERY", "1"))
+    step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0"))
+    stop_size = int(os.environ.get("ELASTIC_STOP_AT_SIZE", "0"))
+    after_grow = int(os.environ.get("ELASTIC_STEPS_AFTER_GROW", "3"))
+
+    state = hvd.elastic.ObjectState(w=np.zeros(4, np.float32),
+                                    step=0, grown_at=-1)
+    state.register_reset_callbacks(
+        [lambda: print(f"RESET size {hvd.size()}", flush=True)])
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < total:
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name=f"elastic.step{state.step}")
+            print(f"STEP {state.step} {float(out[0])}", flush=True)
+            state.w = state.w + out
+            fi.fire("train.step", str(state.step))
+            state.step += 1
+            if state.step % commit_every == 0:
+                state.commit()
+            # Grow scenario: once the gang reaches the target size, run a
+            # few more steps and stop.  Every rank computes the same cut
+            # (size and the synced state agree everywhere), so the break
+            # is collective-safe.
+            if stop_size and hvd.size() >= stop_size:
+                if state.grown_at < 0:
+                    state.grown_at = state.step
+                if state.step - state.grown_at >= after_grow:
+                    break
+            if step_sleep:
+                time.sleep(step_sleep)
+
+    train(state)
+    print(f"FINAL_W {float(state.w[0])}", flush=True)
+    print(f"FINAL_EPOCH {os.environ.get('HVD_ELASTIC_EPOCH', '0')}",
+          flush=True)
+    print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

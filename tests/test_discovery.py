@@ -1,0 +1,93 @@
+"""TPU pod topology discovery unit tests (``runner/discovery.py``):
+metadata parsing edge cases (malformed worker ids, multislice
+coordinates) and the hierarchical block-layout invariant."""
+
+import pytest
+
+from horovod_tpu.runner.discovery import (
+    PodTopology,
+    block_topology_ok,
+    from_mpi_env,
+    from_tpu_metadata,
+)
+
+_TPU_VARS = ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+             "MEGASCALE_SLICE_ID", "MEGASCALE_NUM_SLICES")
+
+
+@pytest.fixture(autouse=True)
+def clear_pod_env(monkeypatch):
+    for k in _TPU_VARS:
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_no_metadata_returns_none():
+    assert from_tpu_metadata() is None
+
+
+def test_single_slice_pod(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    t = from_tpu_metadata()
+    assert t == PodTopology(rank=2, size=4, local_rank=2, local_size=4,
+                            cross_rank=0, cross_size=1)
+
+
+def test_multislice_megascale_coords(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "3")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    t = from_tpu_metadata()
+    # Block layout: rank = slice * hosts_per_slice + worker.
+    assert t == PodTopology(rank=7, size=8, local_rank=1, local_size=2,
+                            cross_rank=3, cross_size=4)
+    assert block_topology_ok(t.rank, t.size, t.local_rank, t.local_size,
+                             t.cross_rank, t.cross_size)
+
+
+def test_malformed_worker_id_is_not_a_pod(monkeypatch):
+    # k8s setups exporting a worker *name* must not crash init().
+    monkeypatch.setenv("TPU_WORKER_ID", "tpu-worker-0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    assert from_tpu_metadata() is None
+
+
+def test_malformed_megascale_id_is_not_a_pod(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "slice-a")
+    assert from_tpu_metadata() is None
+
+
+def test_hostnames_whitespace_and_empties(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", " h0 ,, h1 ,")
+    t = from_tpu_metadata()
+    assert t.local_size == 2 and t.size == 2
+
+
+def test_block_topology_ok_edges():
+    # Genuine 2x4 block layout.
+    assert block_topology_ok(5, 8, 1, 4, 1, 2)
+    # Flat worlds are not hierarchical.
+    assert not block_topology_ok(0, 4, 0, 1, 0, 4)
+    assert not block_topology_ok(0, 4, 0, 4, 0, 1)
+    # local*cross must cover the world exactly.
+    assert not block_topology_ok(0, 6, 0, 4, 0, 2)
+    # Rank must sit at its block coordinate (map-by-node violates this).
+    assert not block_topology_ok(1, 8, 1, 4, 1, 2)
+
+
+def test_mpi_env_degrades_to_flat_on_bad_layout(monkeypatch):
+    for k in ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+              "OMPI_COMM_WORLD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_SIZE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    # map-by-node layout: rank 1 claims local_rank 0 — not block order.
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_RANK", "0")
+    monkeypatch.setenv("OMPI_COMM_WORLD_LOCAL_SIZE", "2")
+    t = from_mpi_env()
+    assert t.rank == 1 and t.size == 4
+    assert (t.local_rank, t.local_size) == (0, 1)  # degraded to flat

@@ -1,0 +1,37 @@
+"""Docs lint as a test: every env knob in ``utils/env.py`` must appear
+in ``docs/`` (tools/check_env_docs.py)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_env_docs  # noqa: E402
+
+
+def test_knob_registry_parses():
+    knobs = check_env_docs.declared_knobs()
+    # Sanity: the registry is non-trivial and includes old + new knobs.
+    assert "HVD_FUSION_THRESHOLD" in knobs
+    assert "HVD_ELASTIC_EPOCH" in knobs
+    assert len(knobs) > 20
+
+
+def test_every_env_knob_is_documented():
+    missing = check_env_docs.missing_knobs()
+    assert not missing, (
+        f"undocumented env knobs: {missing} — add them to docs/ "
+        "(see tools/check_env_docs.py)")
+
+
+def test_word_boundary_matching(tmp_path):
+    env_py = tmp_path / "env.py"
+    env_py.write_text('A = "HVD_FOO"\nB = "HVD_FOO_BAR"\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    # HVD_FOO_BAR mentions must NOT satisfy HVD_FOO's own entry... but
+    # HVD_FOO is a word inside `HVD_FOO`-with-backticks and (HVD_FOO).
+    (docs / "a.md").write_text("only `HVD_FOO_BAR` is documented here")
+    assert check_env_docs.missing_knobs(env_py, docs) == ["HVD_FOO"]
+    (docs / "b.md").write_text("and (HVD_FOO) too")
+    assert check_env_docs.missing_knobs(env_py, docs) == []

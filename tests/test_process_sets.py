@@ -1,0 +1,63 @@
+"""Process-set registry unit tests: stable ids, the id-collision guard
+(ids are a 31-bit hash of the member list — distinct sets can collide,
+and silently sharing an id would route subgroup traffic to the wrong
+members), and the elastic reset hook."""
+
+import pytest
+
+from horovod_tpu import process_sets
+from horovod_tpu.process_sets import ProcessSet
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    process_sets.reset()
+    yield
+    process_sets.reset()
+
+
+def test_same_members_same_id():
+    a = ProcessSet([2, 0])
+    b = ProcessSet([0, 2])  # order and duplicates must not matter
+    c = ProcessSet([0, 0, 2])
+    assert a.process_set_id == b.process_set_id == c.process_set_id
+    assert a.ranks == [0, 2]
+
+
+def test_distinct_members_distinct_id():
+    ids = {ProcessSet(m).process_set_id
+           for m in ([0], [1], [0, 1], [0, 2], [1, 2], [0, 1, 2])}
+    assert len(ids) == 6
+    assert process_sets.GLOBAL_ID not in ids  # 0 is reserved
+
+
+def test_registry_lookup_and_reset():
+    ps = ProcessSet([1, 3])
+    assert process_sets.ranks_of(ps.process_set_id) == [1, 3]
+    assert process_sets.ranks_of(process_sets.GLOBAL_ID) is None
+    process_sets.reset()
+    assert process_sets.ranks_of(ps.process_set_id) is None
+
+
+def test_id_collision_raises_clear_error(monkeypatch):
+    # Force the hash to collide: every member list maps to one id.
+    monkeypatch.setattr(process_sets, "_set_id", lambda ranks: 42)
+    ProcessSet([0, 1])
+    with pytest.raises(ValueError) as ei:
+        ProcessSet([2, 3])
+    msg = str(ei.value)
+    assert "collision" in msg
+    assert "[0, 1]" in msg and "[2, 3]" in msg
+    assert "42" in msg
+    # Re-registering the *same* members under the colliding id is fine.
+    ProcessSet([1, 0])
+
+
+def test_validate_membership():
+    ps = ProcessSet([0, 2])
+    set_id, size = ps.validate(rank=2, world_size=4)
+    assert (set_id, size) == (ps.process_set_id, 2)
+    with pytest.raises(ValueError):
+        ps.validate(rank=1, world_size=4)  # not a member
+    with pytest.raises(ValueError):
+        ps.validate(rank=0, world_size=2)  # member 2 outside the world

@@ -50,10 +50,11 @@ def test_request_roundtrip():
     ]
     data = wire.encode_request_list(reqs, shutdown=True,
                                     cache_hits=[("layer1/w:grad", 7)])
-    out, shutdown, hits = wire.decode_request_list(data)
+    out, shutdown, hits, epoch = wire.decode_request_list(data)
     assert shutdown is True
     assert out == reqs
     assert hits == [("layer1/w:grad", 7)]
+    assert epoch == 0
 
 
 def test_response_roundtrip():
@@ -67,18 +68,20 @@ def test_response_roundtrip():
     data = wire.encode_response_list(resps, shutdown=False,
                                      hit_positions=[3, 0],
                                      resend_names=["x"])
-    out, shutdown, hit_pos, resend, params = wire.decode_response_list(data)
+    out, shutdown, hit_pos, resend, params, epoch = \
+        wire.decode_response_list(data)
     assert shutdown is False
     assert out == resps
     assert hit_pos == [3, 0]
     assert resend == ["x"]
     assert params is None
+    assert epoch == 0
 
 
 def test_response_list_params_roundtrip():
     data = wire.encode_response_list(
         [], params=(32 << 20, 0.0035, False, True, False))
-    _, _, _, _, params = wire.decode_response_list(data)
+    _, _, _, _, params, _ = wire.decode_response_list(data)
     assert params == (32 << 20, 0.0035, False, True, False)
 
 
@@ -88,14 +91,37 @@ def test_response_shapes_roundtrip():
                     devices=["cpu"], tensor_sizes=[24, 4],
                     tensor_shapes=[TensorShape([3, 8]), TensorShape([4])])
     data = wire.encode_response_list([resp])
-    out, _, _, _, _ = wire.decode_response_list(data)
+    out, _, _, _, _, _ = wire.decode_response_list(data)
     assert out[0].tensor_shapes == [TensorShape([3, 8]), TensorShape([4])]
 
 
 def test_empty_lists():
-    reqs, sd, hits = wire.decode_request_list(wire.encode_request_list([]))
-    assert reqs == [] and sd is False and hits == []
-    resps, sd, hit_pos, resend, params = wire.decode_response_list(
+    reqs, sd, hits, epoch = wire.decode_request_list(
+        wire.encode_request_list([]))
+    assert reqs == [] and sd is False and hits == [] and epoch == 0
+    resps, sd, hit_pos, resend, params, epoch = wire.decode_response_list(
         wire.encode_response_list([]))
     assert resps == [] and sd is False and hit_pos == [] and resend == []
-    assert params is None
+    assert params is None and epoch == 0
+
+
+def test_epoch_trailer_roundtrip():
+    # Elastic membership epoch rides both list frames.
+    data = wire.encode_request_list([], epoch=7)
+    _, _, _, epoch = wire.decode_request_list(data)
+    assert epoch == 7
+    data = wire.encode_response_list(
+        [], params=(1 << 20, 0.005, True, False, False), epoch=41)
+    _, _, _, _, params, epoch = wire.decode_response_list(data)
+    assert epoch == 41 and params is not None
+
+
+def test_epoch_trailer_missing_defaults_to_zero():
+    # Frames from encoders that predate the trailer (or from the native
+    # core built before the mirror) must decode as epoch 0.
+    full = wire.encode_request_list([], cache_hits=[("t", 1)], epoch=5)
+    _, _, _, epoch = wire.decode_request_list(full[:-4])
+    assert epoch == 0
+    full = wire.encode_response_list([], hit_positions=[2], epoch=5)
+    _, _, _, _, _, epoch = wire.decode_response_list(full[:-4])
+    assert epoch == 0

@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Lint: every env knob declared in ``horovod_tpu/utils/env.py`` must be
+mentioned somewhere under ``docs/``.
+
+Knobs are the module-level string constants whose values start with
+``HVD_`` or ``HOROVOD_`` — the single registry the engines, launcher,
+and config parser read from.  A knob that exists in code but not in the
+docs is a knob users cannot discover; this check keeps the two in sync
+(it is wired into the test suite as ``tests/test_env_docs.py``).
+
+Exact-name matching (word boundaries), so a docs table must spell out
+``HVD_TIMELINE_MARK_CYCLES`` — combined shorthand like
+``HVD_TIMELINE[_MARK_CYCLES]`` does not count.
+
+Usage: ``python tools/check_env_docs.py`` (exit 1 on missing knobs).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+ENV_PY = REPO_ROOT / "horovod_tpu" / "utils" / "env.py"
+DOCS_DIR = REPO_ROOT / "docs"
+
+
+def declared_knobs(env_py: Path = ENV_PY) -> list:
+    """Module-level string constants in env.py naming HVD_*/HOROVOD_*."""
+    tree = ast.parse(env_py.read_text(encoding="utf-8"))
+    knobs = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str) and \
+                v.value.startswith(("HVD_", "HOROVOD_")):
+            knobs.add(v.value)
+    return sorted(knobs)
+
+
+def documented_text(docs_dir: Path = DOCS_DIR) -> str:
+    return "\n".join(p.read_text(encoding="utf-8")
+                     for p in sorted(docs_dir.glob("*.md")))
+
+
+def missing_knobs(env_py: Path = ENV_PY,
+                  docs_dir: Path = DOCS_DIR) -> list:
+    text = documented_text(docs_dir)
+    # Word-boundary match: HVD_AUTOTUNE must not satisfy
+    # HVD_AUTOTUNE_LOG (knob names are valid identifier words).
+    return [k for k in declared_knobs(env_py)
+            if not re.search(rf"\b{re.escape(k)}\b", text)]
+
+
+def main() -> int:
+    missing = missing_knobs()
+    if missing:
+        print("env knobs declared in horovod_tpu/utils/env.py but not "
+              "mentioned anywhere in docs/*.md:", file=sys.stderr)
+        for k in missing:
+            print(f"  {k}", file=sys.stderr)
+        print("document each knob (docs/running.md has the main table; "
+              "subsystem docs are fine too), or remove it from env.py.",
+              file=sys.stderr)
+        return 1
+    print(f"ok: all {len(declared_knobs())} env knobs are documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
