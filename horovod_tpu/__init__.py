@@ -55,7 +55,11 @@ from horovod_tpu.basics import (  # noqa: F401
     size,
     xla_built,
 )
-from horovod_tpu.common.types import RanksFailedError, ReduceOp  # noqa: F401
+from horovod_tpu.common.types import (  # noqa: F401
+    RanksFailedError,
+    ReduceOp,
+    ReplicaDivergenceError,
+)
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.process_sets import ProcessSet  # noqa: F401
 from horovod_tpu.ops.eager import (  # noqa: F401
@@ -86,6 +90,7 @@ from horovod_tpu.parallel.optimizer import (  # noqa: F401
 )
 from horovod_tpu import data  # noqa: F401  (sharded sampling + prefetch)
 from horovod_tpu import elastic  # noqa: F401  (commit/rollback + re-form)
+from horovod_tpu import integrity  # noqa: F401  (data-plane integrity)
 from horovod_tpu.parallel.multihost import (  # noqa: F401
     init_jax_distributed,
 )

@@ -30,7 +30,10 @@ Fault fields:
 * ``kind``   — ``drop`` | ``error`` (both raise :class:`InjectedFault`,
   a ``ConnectionError`` so existing network error handling engages),
   ``delay`` (sleep ``delay_s``), ``kill`` (``os._exit(137)``, the
-  SIGKILL-style death a supervisor sees).
+  SIGKILL-style death a supervisor sees), ``corrupt`` (data-plane
+  poisoning: fires only at :func:`should_corrupt` sites, where the
+  call site itself applies the corruption — NaN gradients, a flipped
+  bit, a torn checkpoint file).
 * ``match``  — substring that must appear in the call's ``detail``.
 * ``times``  — fire at most this many times (default: unlimited).
 * ``after``  — skip the first N matching passes (default 0).
@@ -50,6 +53,38 @@ from typing import List, Optional
 
 ENV_VAR = "HOROVOD_FAULT_PLAN"
 
+# Canonical injection-site registry: every site literal passed to
+# :func:`fire` / :func:`should_corrupt` anywhere in the package (plus the
+# documented user-level sites, like the ``train.step`` a training script
+# fires itself) must be listed here, and every entry must appear in the
+# docs/fault_tolerance.md site table — enforced by
+# tools/check_fault_sites.py (wired as tests/test_fault_sites.py).
+KNOWN_SITES = {
+    # control plane (fire)
+    "sock.send": "mesh data-socket send",
+    "sock.recv": "mesh data-socket recv",
+    "sock.connect": "mesh bootstrap connect",
+    "kv.put": "rendezvous KV client put",
+    "kv.get": "rendezvous KV client get",
+    "kv.delete": "rendezvous KV client delete",
+    "kv.server.request": "rendezvous server request handling",
+    "bootstrap.start": "worker bootstrap entry",
+    "bootstrap.accept": "mesh listener accept loop",
+    "engine.cycle": "PyEngine background cycle",
+    "ctrl.worker.send": "worker->coordinator control send",
+    "ctrl.coord.send": "coordinator->worker control send",
+    "train.step": "user-level per-step site (training scripts)",
+    # data plane (should_corrupt)
+    "grad.nonfinite": "poison local gradients with NaN (eager guard)",
+    "state.bitflip": "flip one bit of the audited replica state",
+    "ckpt.corrupt": "corrupt one file of a just-written checkpoint",
+}
+
+
+def known_sites() -> dict:
+    """Copy of the site registry (site name -> short description)."""
+    return dict(KNOWN_SITES)
+
 
 class InjectedFault(ConnectionError):
     """An artificial failure raised at a fault-injection site."""
@@ -62,7 +97,7 @@ class _Fault:
     def __init__(self, spec: dict):
         self.site = spec["site"]
         self.kind = spec.get("kind", "error")
-        if self.kind not in ("drop", "error", "delay", "kill"):
+        if self.kind not in ("drop", "error", "delay", "kill", "corrupt"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         self.match = spec.get("match")
         self.times = spec.get("times")
@@ -94,21 +129,30 @@ def fire(site: str, detail: str = "") -> None:
     _fire_slow(plan, site, detail)
 
 
+def _matches_and_arms(plan: _Plan, f: _Fault, detail: str) -> bool:
+    """Shared pass/fire bookkeeping for one site-matched fault."""
+    if f.match is not None and f.match not in detail:
+        return False
+    with plan.lock:
+        f.hits += 1
+        if f.hits <= f.after:
+            return False
+        if f.times is not None and f.fired >= f.times:
+            return False
+        if f.prob is not None and plan.rng.random() >= f.prob:
+            return False
+        f.fired += 1
+    return True
+
+
 def _fire_slow(plan: _Plan, site: str, detail: str) -> None:
     for f in plan.faults:
-        if f.site != site:
+        if f.site != site or f.kind == "corrupt":
+            # corrupt faults only arm at should_corrupt() sites — a
+            # fire() site cannot apply a data corruption.
             continue
-        if f.match is not None and f.match not in detail:
+        if not _matches_and_arms(plan, f, detail):
             continue
-        with plan.lock:
-            f.hits += 1
-            if f.hits <= f.after:
-                continue
-            if f.times is not None and f.fired >= f.times:
-                continue
-            if f.prob is not None and plan.rng.random() >= f.prob:
-                continue
-            f.fired += 1
         if f.kind == "delay":
             time.sleep(f.delay_s)
             continue
@@ -117,6 +161,23 @@ def _fire_slow(plan: _Plan, site: str, detail: str) -> None:
         raise InjectedFault(
             f"injected {f.kind} at {site!r}"
             + (f" ({detail})" if detail else ""))
+
+
+def should_corrupt(site: str, detail: str = "") -> bool:
+    """Data-corruption hook.  Returns True when an armed ``corrupt``
+    fault names ``site`` — the call site then applies the actual
+    corruption (it knows what a NaN gradient / flipped bit / torn file
+    looks like).  Same zero-cost contract as :func:`fire` when no plan
+    is active."""
+    plan = _PLAN
+    if plan is None:
+        return False
+    for f in plan.faults:
+        if f.site != site or f.kind != "corrupt":
+            continue
+        if _matches_and_arms(plan, f, detail):
+            return True
+    return False
 
 
 def configure(spec: Optional[dict]) -> None:

@@ -147,6 +147,34 @@ class RanksFailedError(RuntimeError):
             f"automatically)")
 
 
+class ReplicaDivergenceError(RanksFailedError):
+    """The replica-divergence audit found rank(s) whose replicated state
+    no longer bit-matches the gang's (silent corruption: a flipped bit,
+    a non-deterministic kernel, bad HBM).
+
+    Subclasses :class:`RanksFailedError` with ``.ranks`` = the deviant
+    rank(s), so ``@hvd.elastic.run`` treats it exactly like a dead rank:
+    the deviants are evicted, the survivors roll back to the last commit
+    and re-form.  Every rank computes the identical verdict from the
+    same allgathered digests, so the deviant evicts *itself* (it exits
+    instead of re-joining) while the survivors agree on the new world.
+    """
+
+    def __init__(self, ranks, leaf_path: str = "",
+                 digests=None):
+        self.leaf_path = leaf_path
+        self.digests = dict(digests or {})
+        RuntimeError.__init__(self)  # skip RanksFailedError's message
+        self.ranks = sorted(int(r) for r in ranks)
+        detail = f" (first divergent leaf: {leaf_path})" if leaf_path \
+            else ""
+        self.args = (
+            f"replica state diverged on rank(s) {self.ranks}{detail}; "
+            f"the replicated parameters no longer bit-match across the "
+            f"gang — evict the deviant rank(s) and restore survivors "
+            f"from the last commit/checkpoint",)
+
+
 class StatusType(enum.IntEnum):
     OK = 0
     UNKNOWN_ERROR = 1

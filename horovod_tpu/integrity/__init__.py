@@ -1,0 +1,43 @@
+"""Data-plane integrity: trust, but verify, the numbers themselves.
+
+The fault-tolerance stack (heartbeats, elastic re-form) handles ranks
+that *die*; this package handles ranks that *lie* — silently corrupted
+data that would otherwise train a broken model:
+
+* :mod:`~horovod_tpu.integrity.nonfinite` — NaN/Inf gradient guard with
+  a 1-element MAX-allreduce agreement so every rank skips (or zeros, or
+  raises on) the same step (``HVD_NONFINITE_POLICY``); wired into
+  :func:`~horovod_tpu.parallel.optimizer.DistributedOptimizer`.
+* :mod:`~horovod_tpu.integrity.audit` — replica-divergence audit: leafwise
+  bit-pattern fingerprints of the replicated state, allgathered and
+  compared every ``HVD_AUDIT_INTERVAL`` steps; deviants raise
+  :class:`ReplicaDivergenceError` and feed elastic eviction.
+* verified checkpoints live in :mod:`horovod_tpu.utils.checkpoint`
+  (``save_verified`` / ``restore_verified``): atomic writes, sha256
+  manifests, fallback restore.
+
+See docs/fault_tolerance.md ("Data-plane integrity").
+"""
+
+from horovod_tpu.common.types import ReplicaDivergenceError
+from horovod_tpu.integrity.audit import (ReplicaAuditor, audit_replicas,
+                                         fingerprint)
+from horovod_tpu.integrity.nonfinite import (GuardState, NonFiniteGradientError,
+                                             NonFiniteGuard)
+from horovod_tpu.integrity.nonfinite import counters as nonfinite_counters
+from horovod_tpu.integrity.nonfinite import reset_counters \
+    as reset_nonfinite_counters
+from horovod_tpu.integrity.nonfinite import stats as nonfinite_stats
+
+__all__ = [
+    "ReplicaAuditor",
+    "ReplicaDivergenceError",
+    "NonFiniteGradientError",
+    "NonFiniteGuard",
+    "GuardState",
+    "audit_replicas",
+    "fingerprint",
+    "nonfinite_counters",
+    "reset_nonfinite_counters",
+    "nonfinite_stats",
+]

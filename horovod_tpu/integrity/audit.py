@@ -1,0 +1,163 @@
+"""Replica-divergence audit: catch silent bit-level state corruption.
+
+Data-parallel training assumes the replicated parameters are *identical*
+on every rank — one flipped bit (bad HBM, a non-deterministic kernel, a
+torn host transfer) silently forks the model, and the fork only shows up
+much later as an unexplained loss excursion.  The audit makes the
+assumption checked:
+
+1. every ``HVD_AUDIT_INTERVAL`` steps each rank fingerprints its
+   replicated tree — a bit-pattern sha256 digest per leaf (dtype + shape
+   + raw bytes), folded into one 64-bit digest,
+2. the per-leaf digest vectors allgather (as int64 bit patterns — the
+   wire has no uint64),
+3. every rank compares the identical gathered matrix and computes the
+   identical verdict: all folded digests equal → clean; otherwise the
+   majority digest is canonical (ties break to the digest held by the
+   lowest rank) and every other rank is a deviant.
+
+On divergence the audit records ``DIVERGENCE_DETECTED`` on the timeline
+and raises :class:`ReplicaDivergenceError` naming the deviant rank(s)
+and the first divergent leaf path.  Because the error subclasses
+``RanksFailedError`` with ``.ranks`` = the deviants, ``@hvd.elastic.run``
+treats it like a dead rank: survivors roll back to the last commit and
+re-form without the deviant, and the deviant — which reached the very
+same verdict about itself — exits instead of re-joining.
+
+The ``state.bitflip`` fault-injection site lives in
+:func:`fingerprint`: an armed ``corrupt`` fault flips one bit of the
+first leaf's bytes before digesting, simulating the silent corruption
+end to end (tests/test_integrity.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import Counter
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.common.types import ReplicaDivergenceError
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import timeline as timeline_mod
+
+
+def _digest8(chunks) -> int:
+    h = hashlib.sha256()
+    for c in chunks:
+        h.update(c)
+    return int.from_bytes(h.digest()[:8], "little")
+
+
+def fingerprint(tree, _detail: str = "") -> Tuple[int, List[Tuple[str, int]]]:
+    """``(folded, [(leaf_path, digest), ...])`` over a pytree's leaves.
+
+    Digests cover dtype + shape + raw bytes, so a dtype drift and a value
+    drift are equally visible.  The fold is a sha256 over the per-leaf
+    digests, so any single-leaf change moves the folded digest.
+    """
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    flip = _fi.should_corrupt("state.bitflip", _detail)
+    per_leaf: List[Tuple[str, int]] = []
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        buf = arr.tobytes()
+        if flip and buf:
+            # The injected silent corruption: one bit of the first
+            # audited leaf, exactly what bad memory produces.
+            ba = bytearray(buf)
+            ba[0] ^= 0x01
+            buf = bytes(ba)
+            flip = False
+        per_leaf.append((
+            jax.tree_util.keystr(path),
+            _digest8([str(arr.dtype).encode(),
+                      np.asarray(arr.shape, np.int64).tobytes(),
+                      buf])))
+    folded = _digest8(
+        [d.to_bytes(8, "little") for _, d in per_leaf])
+    return folded, per_leaf
+
+
+def _verdict(mat: np.ndarray) -> Tuple[List[int], int]:
+    """Deviant ranks + the canonical row index, from the folded column.
+
+    Majority digest wins; ties break to the digest held by the lowest
+    rank — deterministic, so every rank (deviants included) agrees.
+    """
+    col = mat[:, 0].tolist()
+    counts = Counter(col)
+    maxc = max(counts.values())
+    canonical = min((d for d, c in counts.items() if c == maxc),
+                    key=col.index)
+    deviants = [r for r, d in enumerate(col) if d != canonical]
+    return deviants, col.index(canonical)
+
+
+def audit_replicas(tree, name: str = "integrity.audit") -> int:
+    """One collective audit round over ``tree`` (replicated state).
+
+    Collective: every rank must call it with its own copy of the same
+    logical tree, in the same order.  Returns the folded digest (all
+    ranks equal) on success; raises :class:`ReplicaDivergenceError` on
+    mismatch.  Works on a single rank too (trivially clean).
+    """
+    from horovod_tpu import basics
+    from horovod_tpu.ops import eager
+
+    folded, per_leaf = fingerprint(tree, _detail=name)
+    # Ride the wire as int64 bit patterns (no uint64 on the wire).
+    local = np.array([folded] + [d for _, d in per_leaf],
+                     dtype=np.uint64).view(np.int64)
+    gathered = eager.allgather(local, name=name)
+    size = basics.size()
+    mat = np.ascontiguousarray(
+        np.asarray(gathered).reshape(size, len(per_leaf) + 1)
+    ).view(np.uint64)
+    if len(set(mat[:, 0].tolist())) == 1:
+        return folded
+    deviants, canon = _verdict(mat)
+    leaf_path = ""
+    for j in range(1, mat.shape[1]):
+        if any(mat[r, j] != mat[canon, j] for r in deviants):
+            leaf_path = per_leaf[j - 1][0]
+            break
+    digests = {r: f"{int(mat[r, 0]):016x}" for r in range(size)}
+    timeline_mod.engine_event(
+        timeline_mod.DIVERGENCE_DETECTED, ranks=deviants,
+        leaf=leaf_path, digests=digests)
+    raise ReplicaDivergenceError(deviants, leaf_path, digests)
+
+
+class ReplicaAuditor:
+    """Paced audit driver for a training loop.
+
+    Call :meth:`maybe_audit` once per step on every rank; every
+    ``interval`` steps (``HVD_AUDIT_INTERVAL``; 0 disables) it runs
+    :func:`audit_replicas`.  The pacing counter is local but advances in
+    lockstep (every rank steps together), so the collective fires on the
+    same step everywhere.
+    """
+
+    def __init__(self, interval: Optional[int] = None):
+        self.interval = interval if interval is not None else \
+            env_util.get_int(env_util.AUDIT_INTERVAL, 0)
+        if self.interval < 0:
+            raise ValueError("audit interval must be >= 0")
+        self.audits = 0     # audit rounds completed clean
+        self._step = 0
+
+    def maybe_audit(self, tree) -> bool:
+        """Returns True when an audit ran (and passed) this step."""
+        if self.interval <= 0:
+            return False
+        self._step += 1
+        if self._step % self.interval:
+            return False
+        audit_replicas(tree, name=f"integrity.audit.{self._step}")
+        self.audits += 1
+        return True

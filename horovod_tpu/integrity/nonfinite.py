@@ -1,0 +1,215 @@
+"""Non-finite gradient guard: agreed skip/zero/raise on NaN or Inf.
+
+One rank's NaN gradient poisons every replica through the allreduce —
+and worse, ranks that *locally* diverge on whether to apply a step
+strand each other in collectives.  The guard makes the decision
+collective and deterministic:
+
+1. each rank computes a local 1-element ``any non-finite`` flag over its
+   gradient tree,
+2. the flags agree via a 1-element **MAX-allreduce** (if any rank saw a
+   non-finite value, every rank sees 1),
+3. every rank applies the same policy to the same step:
+
+   * ``skip`` — drop the step (parameters and inner optimizer state
+     unchanged) and count it,
+   * ``zero`` — replace non-finite gradient entries with zeros and
+     apply the step,
+   * ``raise`` — behave like ``skip``, but raise
+     :class:`NonFiniteGradientError` once ``HVD_NONFINITE_LIMIT``
+     *consecutive* steps agreed non-finite (the loss-scale-collapsed /
+     diverged-model escape hatch),
+   * ``off`` — guard disabled, zero extra collectives (the default;
+     pinned by tests/test_integrity.py).
+
+The policy comes from ``HVD_NONFINITE_POLICY`` unless passed explicitly
+to :func:`~horovod_tpu.parallel.optimizer.DistributedOptimizer`.  Agreed
+skips are recorded on the timeline as ``NONFINITE_SKIP`` events and in
+process-global counters (:func:`counters`) so survivors of a burst can
+be audited after the fact.
+
+Two regimes, matching the optimizer:
+
+* **eager** (``axis=None``): :class:`NonFiniteGuard` runs host-side
+  python — the 1-element agreement rides the engine, and ``raise`` is
+  fully supported.  The ``grad.nonfinite`` fault-injection site lives
+  here (chaos: poison this rank's local gradients with NaN).
+* **in-graph**: the same flag/agreement/masking as traced ops; the
+  counters live in :class:`GuardState` inside the optimizer state
+  (read them with :func:`stats`).  ``raise`` is rejected at wrap time —
+  a data-dependent raise cannot cross a jit boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import timeline as timeline_mod
+
+POLICIES = ("off", "skip", "zero", "raise")
+
+_agg_lock = threading.Lock()
+_agg = {"agreed": 0, "skipped": 0}
+
+
+class NonFiniteGradientError(RuntimeError):
+    """``HVD_NONFINITE_LIMIT`` consecutive steps agreed non-finite under
+    policy ``raise`` — the model has diverged (or the loss scale
+    collapsed); skipping further steps cannot recover it."""
+
+    def __init__(self, consecutive: int, limit: int):
+        self.consecutive = consecutive
+        self.limit = limit
+        super().__init__(
+            f"{consecutive} consecutive step(s) had non-finite gradients "
+            f"on some rank (limit {limit}); every rank agreed via "
+            f"MAX-allreduce and raised together — restore from the last "
+            f"good checkpoint (HVD_NONFINITE_POLICY governs this policy)")
+
+
+def resolve_policy(policy: Optional[str] = None) -> str:
+    """Explicit argument beats ``HVD_NONFINITE_POLICY`` beats ``off``."""
+    p = (policy if policy is not None
+         else env_util.get_str(env_util.NONFINITE_POLICY, "off"))
+    p = (p or "off").strip().lower()
+    if p not in POLICIES:
+        raise ValueError(
+            f"unknown non-finite policy {p!r}; expected one of {POLICIES}")
+    return p
+
+
+def consecutive_limit(limit: Optional[int] = None) -> int:
+    k = limit if limit is not None else env_util.get_int(
+        env_util.NONFINITE_LIMIT, 3)
+    if k < 1:
+        raise ValueError("non-finite consecutive limit must be >= 1")
+    return k
+
+
+def counters() -> dict:
+    """Process-global guard counters: ``agreed`` (steps the gang agreed
+    were non-finite) and ``skipped`` (steps actually dropped)."""
+    with _agg_lock:
+        return dict(_agg)
+
+
+def reset_counters() -> None:
+    with _agg_lock:
+        _agg["agreed"] = 0
+        _agg["skipped"] = 0
+
+
+def _bump(key: str) -> None:
+    with _agg_lock:
+        _agg[key] += 1
+
+
+def _local_nonfinite(leaves) -> bool:
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.all(np.isfinite(arr)):
+            return True
+    return False
+
+
+def _poison_first_float_leaf(grads):
+    """The ``grad.nonfinite`` chaos site: NaN-fill the first floating
+    leaf of this rank's local gradients (what a bad kernel / overflowed
+    loss scale produces)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(grads)
+    for i, leaf in enumerate(leaves):
+        arr = np.array(np.asarray(leaf), copy=True)
+        if arr.dtype.kind == "f":
+            arr.fill(np.nan)
+            leaves[i] = arr
+            break
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class NonFiniteGuard:
+    """Eager-regime guard; one instance per optimizer (or shared).
+
+    ``intercept(grads)`` returns ``(grads, skip)``: with ``skip`` True
+    the caller must drop the step (zero updates, optimizer state
+    untouched).  Collective: every rank must call it once per step, in
+    step order — the agreement allreduce is named by an internal serial.
+    """
+
+    def __init__(self, policy: Optional[str] = None,
+                 limit: Optional[int] = None):
+        self.policy = resolve_policy(policy)
+        if self.policy == "off":
+            raise ValueError(
+                "NonFiniteGuard with policy 'off' is a contradiction; "
+                "simply do not install a guard")
+        self.limit = consecutive_limit(limit)
+        self.nonfinite_steps = 0   # steps the gang agreed were bad
+        self.skipped = 0           # steps actually dropped
+        self.consecutive = 0       # current agreed-bad run length
+        self._serial = 0
+
+    def intercept(self, grads):
+        from horovod_tpu.ops import eager
+
+        self._serial += 1
+        if _fi.should_corrupt("grad.nonfinite", str(self._serial)):
+            grads = _poison_first_float_leaf(grads)
+        import jax
+
+        local = _local_nonfinite(jax.tree.leaves(grads))
+        flag = np.array([1 if local else 0], np.int32)
+        agreed = eager.allreduce(
+            flag, op=ReduceOp.MAX,
+            name=f"integrity.nonfinite.{self._serial}")
+        if int(np.asarray(agreed)[0]) == 0:
+            self.consecutive = 0
+            return grads, False
+        self.nonfinite_steps += 1
+        self.consecutive += 1
+        _bump("agreed")
+        if self.policy == "zero":
+            import jax.numpy as jnp
+
+            grads = jax.tree.map(
+                lambda g: np.where(np.isfinite(np.asarray(g)),
+                                   np.asarray(g), 0).astype(
+                    np.asarray(g).dtype)
+                if np.asarray(g).dtype.kind == "f" else g, grads)
+            return grads, False
+        self.skipped += 1
+        _bump("skipped")
+        timeline_mod.engine_event(
+            timeline_mod.NONFINITE_SKIP, serial=self._serial,
+            policy=self.policy, consecutive=self.consecutive)
+        if self.policy == "raise" and self.consecutive >= self.limit:
+            raise NonFiniteGradientError(self.consecutive, self.limit)
+        return grads, True
+
+
+class GuardState(NamedTuple):
+    """In-graph guard counters wrapped around the inner optimizer state
+    (the in-graph twin of :class:`NonFiniteGuard`'s host-side counters).
+    Read with :func:`stats`."""
+
+    nonfinite_steps: Any
+    consecutive: Any
+    inner: Any
+
+
+def stats(opt_state) -> dict:
+    """Counters from an in-graph guarded optimizer state."""
+    if not isinstance(opt_state, GuardState):
+        raise TypeError(
+            "stats() wants the state of a DistributedOptimizer built "
+            "with an in-graph nonfinite_policy (GuardState); got "
+            f"{type(opt_state).__name__}")
+    return {"nonfinite_steps": int(opt_state.nonfinite_steps),
+            "consecutive": int(opt_state.consecutive)}

@@ -96,6 +96,68 @@ class _AccumState(NamedTuple):
     inner: Any
 
 
+def _is_float(leaf) -> bool:
+    return jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+
+
+def _guarded_ingraph(inner, *, op, axis, compression, hierarchical,
+                     outer_axis, policy):
+    """In-graph non-finite guard: the flag agreement and the gradient
+    allreduce both execute unconditionally (XLA collectives cannot be
+    data-dependent); the *application* is masked.  With policy ``skip``
+    a bad step leaves params and inner state bit-identical; with
+    ``zero`` non-finite entries reduce as zeros.  Counters ride the
+    optimizer state (integrity.nonfinite.GuardState / stats())."""
+    from horovod_tpu.integrity import nonfinite as _nf
+
+    def init_fn(params):
+        return _nf.GuardState(jnp.zeros((), jnp.int32),
+                              jnp.zeros((), jnp.int32),
+                              inner.init(params))
+
+    def update_fn(grads, state, params=None, **extra):
+        finite = jnp.array(True)
+        for leaf in jax.tree.leaves(grads):
+            if _is_float(leaf):
+                finite = jnp.logical_and(finite,
+                                         jnp.all(jnp.isfinite(leaf)))
+        flag = jnp.where(finite, 0, 1).astype(jnp.int32)
+        bad = C.allreduce(flag, op=ReduceOp.MAX, axis=axis)
+        is_bad = bad > 0
+
+        def reduce_and_apply(tree, inner_state):
+            reduced = allreduce_gradients(
+                tree, op=op, axis=axis, compression=compression,
+                hierarchical=hierarchical, outer_axis=outer_axis)
+            return inner.update(reduced, inner_state, params, **extra)
+
+        nonfinite_steps = state.nonfinite_steps + bad
+        consecutive = jnp.where(is_bad, state.consecutive + 1, 0)
+
+        if policy == "zero":
+            safe = jax.tree.map(
+                lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g))
+                if _is_float(g) else g, grads)
+            updates, inner_state = reduce_and_apply(safe, state.inner)
+            return updates, _nf.GuardState(nonfinite_steps, consecutive,
+                                           inner_state)
+
+        # skip: zero the whole tree on a bad step (jnp.where, never a
+        # multiply — NaN * 0 is NaN) so the unconditional reduce and
+        # inner update stay finite, then discard their results.
+        safe = jax.tree.map(
+            lambda g: jnp.where(is_bad, jnp.zeros_like(g), g), grads)
+        updates, inner_state = reduce_and_apply(safe, state.inner)
+        gated = jax.tree.map(
+            lambda u: jnp.where(is_bad, jnp.zeros_like(u), u), updates)
+        picked = jax.tree.map(
+            lambda new, old: jnp.where(is_bad, old, new),
+            inner_state, state.inner)
+        return gated, _nf.GuardState(nonfinite_steps, consecutive, picked)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def DistributedOptimizer(
     inner: optax.GradientTransformation,
     *,
@@ -105,21 +167,68 @@ def DistributedOptimizer(
     backward_passes_per_step: int = 1,
     hierarchical: bool = False,
     outer_axis: str = "dcn",
+    nonfinite_policy: Optional[str] = None,
+    nonfinite_guard=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates see globally-reduced gradients.
 
     ``hierarchical=True`` (in-graph regime only) reduces the fused
     gradient buffers RS(inner/ICI)->AR(outer/DCN)->AG(inner/ICI);
     ``axis`` must name exactly the inner and ``outer_axis`` axes.
+
+    ``nonfinite_policy`` (default: ``HVD_NONFINITE_POLICY``, then
+    ``off``) arms the non-finite gradient guard: a 1-element
+    MAX-allreduce agrees a per-step any-NaN/Inf flag so every rank
+    skips (``skip``), sanitizes (``zero``) or — eager regime only —
+    raises on (``raise``) the *same* step.  ``off`` adds zero extra
+    collectives.  Pass ``nonfinite_guard`` (a
+    :class:`~horovod_tpu.integrity.nonfinite.NonFiniteGuard`) to keep a
+    handle on the eager guard's counters.  Composes with
+    ``backward_passes_per_step == 1`` only.
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
+    from horovod_tpu.integrity import nonfinite as _nf
+
+    guard = nonfinite_guard
+    policy = guard.policy if guard is not None \
+        else _nf.resolve_policy(nonfinite_policy)
+    if policy != "off":
+        if backward_passes_per_step != 1:
+            raise ValueError(
+                "the non-finite gradient guard composes with "
+                "backward_passes_per_step == 1 only; accumulate at the "
+                "data-loader level to combine them")
+        if axis is not None:
+            if policy == "raise":
+                raise ValueError(
+                    "nonfinite_policy 'raise' needs host control flow "
+                    "and is eager-only (axis=None); in-graph use 'skip' "
+                    "and watch integrity.nonfinite_stats(opt_state)")
+            if guard is not None:
+                raise ValueError(
+                    "nonfinite_guard is the eager-regime (axis=None) "
+                    "hook; in-graph counters live in the optimizer "
+                    "state (integrity.nonfinite_stats)")
+        elif guard is None:
+            guard = _nf.NonFiniteGuard(policy)
+
     if backward_passes_per_step == 1:
+        if policy != "off" and axis is not None:
+            return _guarded_ingraph(
+                inner, op=op, axis=axis, compression=compression,
+                hierarchical=hierarchical, outer_axis=outer_axis,
+                policy=policy)
+
         def init_fn(params):
             return inner.init(params)
 
         def update_fn(grads, state, params=None, **extra):
+            if guard is not None:
+                grads, skip = guard.intercept(grads)
+                if skip:
+                    return jax.tree.map(jnp.zeros_like, grads), state
             reduced = allreduce_gradients(
                 grads, op=op, axis=axis, compression=compression,
                 hierarchical=hierarchical, outer_axis=outer_axis)

@@ -81,12 +81,14 @@ def params_hash(np: int, hosts: Optional[str],
                 ssh_port: Optional[int],
                 ssh_identity_file: Optional[str] = None) -> str:
     """Hash of the launch parameters that affect init checks (parity:
-    run/run.py:600-607 md5 over np + hosts + ssh_port).  The identity
-    file is part of the key: switching credentials must invalidate a
-    cached reachability verdict probed with the old key."""
+    run/run.py:600-607 hashes np + hosts + ssh_port; sha256 here — md5
+    is rejected outright on FIPS-mode hosts, and this is a cache key,
+    not a compatibility surface).  The identity file is part of the key:
+    switching credentials must invalidate a cached reachability verdict
+    probed with the old key."""
     params = (f"{np} {hosts or ''} {ssh_port or ''} "
               f"{ssh_identity_file or ''}")
-    return hashlib.md5(params.encode()).hexdigest()
+    return hashlib.sha256(params.encode()).hexdigest()
 
 
 class SSHUnreachableError(RuntimeError):

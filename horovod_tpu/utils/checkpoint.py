@@ -17,12 +17,44 @@ checkpoints work out of the box):
 * :func:`resume_or_init` — the standard training-loop entry: restore the
   latest step if a checkpoint exists, else initialize fresh and
   broadcast from rank 0 so every rank starts identical.
+
+On top of that sits the *verified* layer (docs/fault_tolerance.md,
+"Data-plane integrity"): a checkpoint that restores without error is not
+necessarily the checkpoint that was written — torn writes and bit rot
+restore fine and train a corrupted model.
+
+* :func:`save_verified` — atomic write (temp dir + rename) under
+  ``<root>/step_<n>``, plus a ``step_<n>.manifest.json`` sidecar with a
+  sha256 per file, the step, and the elastic membership epoch; prunes to
+  the newest ``HVD_CKPT_KEEP`` checkpoints.
+* :func:`restore_verified` — newest-first: re-hash every file against
+  the manifest, fall back to the next-newest checkpoint on any mismatch
+  (recording ``CKPT_VERIFY_FAIL`` on the timeline), raise
+  :class:`CheckpointVerifyError` only when nothing verifies.
+
+The ``ckpt.corrupt`` fault-injection site fires right after a verified
+save, poisoning one file the way a disk would — tests/test_integrity.py
+proves the fallback end to end.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
 import os
-from typing import Any, Callable, Optional
+import re
+import shutil
+from typing import Any, Callable, List, Optional, Tuple
+
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import timeline as timeline_mod
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
+MANIFEST_FORMAT = 1
+_STEP_DIR = re.compile(r"^step_(\d+)$")
 
 
 def _is_sharded(tree) -> bool:
@@ -99,3 +131,191 @@ def resume_or_init(path: str, init_fn: Callable[[], Any],
 
         state = eager.broadcast_parameters(state, 0, prefix="ckpt.init")
     return state
+
+
+# -- verified checkpoints -------------------------------------------------
+
+
+class CheckpointVerifyError(RuntimeError):
+    """Checkpoints exist under the root but none passed verification."""
+
+    def __init__(self, root: str, failures):
+        self.root = root
+        self.failures = list(failures)
+        detail = "; ".join(f"{os.path.basename(p)}: {r}"
+                           for p, r in self.failures)
+        super().__init__(
+            f"no verifiable checkpoint under {root!r} — every candidate "
+            f"failed its manifest check ({detail}); restore from a backup "
+            f"or re-initialize")
+
+
+def manifest_path(ckpt_dir: str) -> str:
+    return ckpt_dir.rstrip("/") + ".manifest.json"
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_files(root: str) -> List[str]:
+    out = []
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            out.append(os.path.relpath(os.path.join(dirpath, n), root))
+    return sorted(out)
+
+
+def _write_manifest(ckpt_dir: str, step: int, epoch: int) -> None:
+    files = {}
+    for rel in _walk_files(ckpt_dir):
+        full = os.path.join(ckpt_dir, rel)
+        files[rel] = {"sha256": _sha256_file(full),
+                      "bytes": os.path.getsize(full)}
+    manifest = {"format": MANIFEST_FORMAT, "step": step, "epoch": epoch,
+                "files": files}
+    target = manifest_path(ckpt_dir)
+    tmp = f"{target}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, target)
+
+
+def verify_checkpoint(ckpt_dir: str) -> Tuple[bool, str]:
+    """``(ok, reason)`` — re-hash every manifest-listed file.
+
+    Extra files are tolerated (orbax layouts vary by version); missing
+    or mismatching ones are not.
+    """
+    mpath = manifest_path(ckpt_dir)
+    if not os.path.isfile(mpath):
+        return False, "no manifest sidecar"
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        files = manifest["files"]
+    except (ValueError, KeyError, TypeError) as e:
+        return False, f"unreadable manifest ({e})"
+    for rel, meta in sorted(files.items()):
+        full = os.path.join(ckpt_dir, rel)
+        if not os.path.isfile(full):
+            return False, f"missing file {rel!r}"
+        if _sha256_file(full) != meta.get("sha256"):
+            return False, f"sha256 mismatch on {rel!r}"
+    return True, ""
+
+
+def list_steps(root: str) -> List[Tuple[int, str]]:
+    """``(step, dir)`` pairs under ``root``, newest step first."""
+    out = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            m = _STEP_DIR.match(name)
+            if m and os.path.isdir(os.path.join(root, name)):
+                out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out, reverse=True)
+
+
+def _corrupt_one_file(ckpt_dir: str) -> None:
+    """The ``ckpt.corrupt`` chaos payload: flip one byte in the middle of
+    the largest file — bit rot / a torn write, after the manifest was
+    sealed, exactly what verification exists to catch."""
+    rels = _walk_files(ckpt_dir)
+    if not rels:
+        return
+    target = max(rels, key=lambda r: os.path.getsize(
+        os.path.join(ckpt_dir, r)))
+    full = os.path.join(ckpt_dir, target)
+    size = os.path.getsize(full)
+    if size == 0:
+        return
+    with open(full, "r+b") as fh:
+        fh.seek(size // 2)
+        b = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([b[0] ^ 0xFF]))
+
+
+def _prune(root: str, keep: int) -> None:
+    for step, d in list_steps(root)[keep:]:
+        shutil.rmtree(d, ignore_errors=True)
+        try:
+            os.remove(manifest_path(d))
+        except OSError:
+            pass
+
+
+def save_verified(root: str, tree: Any, *, step: int,
+                  keep: Optional[int] = None,
+                  force: bool = True) -> Optional[str]:
+    """Atomically write ``<root>/step_<step>`` + manifest; prune to the
+    newest ``keep`` (``HVD_CKPT_KEEP``, default 3).  Returns the final
+    directory, or None on a non-writing (non-root, replicated) rank —
+    same gating and no-barrier caveat as :func:`save`.
+    """
+    import orbax.checkpoint as ocp
+
+    from horovod_tpu import basics
+
+    keep = keep if keep is not None else env_util.get_int(
+        env_util.CKPT_KEEP, 3)
+    if keep < 1:
+        raise ValueError("checkpoint retention (keep) must be >= 1")
+    final = os.path.join(root, f"step_{step}")
+    sharded = _is_sharded(tree)
+    if not sharded and basics.is_initialized() and basics.rank() != 0:
+        return None
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, f".tmp.step_{step}.{os.getpid()}")
+    shutil.rmtree(tmp, ignore_errors=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(tmp, tree, force=True)
+    ckptr.wait_until_finished()
+    finalize = not (sharded and basics.is_initialized()
+                    and basics.rank() != 0)
+    if finalize:
+        if os.path.isdir(final):
+            if not force:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise FileExistsError(final)
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        epoch = env_util.get_int(env_util.ELASTIC_EPOCH, 0)
+        _write_manifest(final, step, epoch)
+        if _fi.should_corrupt("ckpt.corrupt", final):
+            _corrupt_one_file(final)
+        _prune(root, keep)
+    return final
+
+
+def restore_verified(root: str, template: Optional[Any] = None
+                     ) -> Tuple[Any, int]:
+    """Newest-first verified restore: ``(tree, step)`` from the newest
+    checkpoint whose manifest checks out, falling back past any that
+    don't (each fallback logs a warning and records ``CKPT_VERIFY_FAIL``
+    on the timeline).  Raises FileNotFoundError with no candidates at
+    all, :class:`CheckpointVerifyError` when none verify.
+    """
+    candidates = list_steps(root)
+    if not candidates:
+        raise FileNotFoundError(
+            f"no step_<n> checkpoints under {root!r}")
+    failures = []
+    for step, d in candidates:
+        ok, reason = verify_checkpoint(d)
+        if not ok:
+            logger.warning(
+                "checkpoint %s failed verification (%s); "
+                "falling back to the next newest", d, reason)
+            timeline_mod.engine_event(
+                timeline_mod.CKPT_VERIFY_FAIL, path=d, reason=reason)
+            failures.append((d, reason))
+            continue
+        return restore(d, template), step
+    raise CheckpointVerifyError(root, failures)

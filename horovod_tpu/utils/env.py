@@ -53,6 +53,16 @@ ELASTIC_UID = "HVD_ELASTIC_UID"
 ELASTIC_CHECK_INTERVAL_S = "HVD_ELASTIC_CHECK_INTERVAL_S"
 ELASTIC_DISCOVERY_INTERVAL_S = "HVD_ELASTIC_DISCOVERY_INTERVAL_S"
 HOST_DISCOVERY_SCRIPT = "HVD_HOST_DISCOVERY_SCRIPT"
+# Data-plane integrity (horovod_tpu.integrity; docs/fault_tolerance.md).
+# POLICY gates the non-finite gradient guard in DistributedOptimizer
+# (off | skip | zero | raise); LIMIT is the consecutive agreed-non-finite
+# step count after which policy "raise" raises; AUDIT_INTERVAL paces the
+# replica-divergence audit (steps; 0 = off); CKPT_KEEP is the verified
+# checkpoint keep-last-K retention.
+NONFINITE_POLICY = "HVD_NONFINITE_POLICY"
+NONFINITE_LIMIT = "HVD_NONFINITE_LIMIT"
+AUDIT_INTERVAL = "HVD_AUDIT_INTERVAL"
+CKPT_KEEP = "HVD_CKPT_KEEP"
 
 
 def get_bool(name: str, default: bool = False) -> bool:

@@ -37,6 +37,11 @@ CPU_RING_ALLREDUCE = "CPU_RING_ALLREDUCE"
 XLA_ALLREDUCE = "XLA_ALLREDUCE"
 CYCLE_START = "CYCLE_START"
 
+# Data-plane integrity records (horovod_tpu.integrity).
+NONFINITE_SKIP = "NONFINITE_SKIP"
+DIVERGENCE_DETECTED = "DIVERGENCE_DETECTED"
+CKPT_VERIFY_FAIL = "CKPT_VERIFY_FAIL"
+
 # Live timelines by path: an elastic reset tears the engine down and
 # re-initializes it in the SAME process, and the new engine must append
 # to the trace instead of truncating it — the reset/re-form cycle being
@@ -152,11 +157,15 @@ class Timeline:
         if self._mark_cycles:
             self._emit("i", CYCLE_START, "")
 
-    def elastic_event(self, name: str, **args) -> None:
-        """Instant marker for the elastic reset/re-form cycle
-        (``ELASTIC_RESET`` / ``ELASTIC_REFORM`` / ``ELASTIC_EPOCH_<n>``),
-        on the process lane (tid 0) since it is not tied to a tensor."""
+    def instant(self, name: str, **args) -> None:
+        """Named instant marker on the process lane (tid 0) — events not
+        tied to a tensor: the elastic reset/re-form cycle and the
+        data-plane integrity records (``NONFINITE_SKIP``,
+        ``DIVERGENCE_DETECTED``, ``CKPT_VERIFY_FAIL``)."""
         self._emit("i", name, "", args=args or None)
+
+    # Historical name for the elastic records; same event shape.
+    elastic_event = instant
 
     # -- writer thread ----------------------------------------------------
 
@@ -167,6 +176,19 @@ class Timeline:
                 break
             self._f.write(json.dumps(ev) + ",\n")
             self._f.flush()
+
+
+def engine_event(name: str, **args) -> None:
+    """Emit an instant record on the active engine's timeline, if any —
+    the shared helper for subsystems (integrity, checkpoint) that record
+    events but do not own a Timeline.  Silently a no-op outside an
+    initialized runtime or with the timeline disabled."""
+    from horovod_tpu import basics
+
+    eng = basics._runtime
+    tl = getattr(eng, "timeline", None) if eng is not None else None
+    if tl is not None and tl.enabled:
+        tl.instant(name, **args)
 
 
 def from_env(rank: int) -> Timeline:

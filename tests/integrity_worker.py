@@ -1,0 +1,146 @@
+"""Data-plane-integrity worker for tests/test_integrity.py.
+
+Same contract as tests/chaos_worker.py: one process = one gang member,
+one scenario named on the command line, per-rank fault plans through
+``HOROVOD_FAULT_PLAN``, markers printed with ``flush=True``.
+
+Exit codes:
+
+* 0   — scenario completed as expected
+* 3   — the injected fault never produced its effect
+* 21  — this rank was evicted as a divergence deviant (expected for the
+        bit-flipped rank in ``divergence_evict``)
+* 137 — killed by an injected ``kill`` fault
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+STEPS = 6
+
+
+def _sgd_step(opt, params, opt_state, grads):
+    import optax
+
+    updates, opt_state = opt.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
+
+
+def scenario_nonfinite_skip(hvd, fi):
+    """Eager gang; one rank's plan poisons its local gradients with NaN
+    on one step.  The MAX-allreduce agreement must make EVERY rank skip
+    that same step: parameters stay bit-identical across ranks and the
+    skip counters agree."""
+    import optax
+
+    from horovod_tpu.integrity import nonfinite
+
+    guard = nonfinite.NonFiniteGuard(
+        os.environ.get("INTEGRITY_POLICY", "skip"))
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis=None,
+                                   nonfinite_guard=guard)
+    params = {"w": np.ones(4, np.float32)}
+    opt_state = opt.init(params)
+    for step in range(STEPS):
+        grads = {"w": np.full(4, 0.5, np.float32)}
+        params, opt_state = _sgd_step(opt, params, opt_state, grads)
+        print(f"STEP {step} {float(np.asarray(params['w'])[0]):.6f} "
+              f"skipped={guard.skipped}", flush=True)
+    print(f"COUNTERS agreed={guard.nonfinite_steps} "
+          f"skipped={guard.skipped}", flush=True)
+    print(f"FINAL_W {float(np.asarray(params['w'])[0]):.6f}", flush=True)
+    print("DONE", flush=True)
+    hvd.shutdown()
+
+
+def scenario_nonfinite_raise(hvd, fi):
+    """Policy ``raise`` with limit 2: two consecutive poisoned steps on
+    one rank must make EVERY rank raise NonFiniteGradientError together
+    (the un-poisoned ranks raise purely from the agreement)."""
+    import optax
+
+    from horovod_tpu.integrity import nonfinite
+
+    guard = nonfinite.NonFiniteGuard("raise", limit=2)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis=None,
+                                   nonfinite_guard=guard)
+    params = {"w": np.ones(4, np.float32)}
+    opt_state = opt.init(params)
+    try:
+        for step in range(STEPS):
+            grads = {"w": np.full(4, 0.5, np.float32)}
+            params, opt_state = _sgd_step(opt, params, opt_state, grads)
+            print(f"STEP {step}", flush=True)
+        print("NO_RAISE", flush=True)
+        os._exit(3)
+    except nonfinite.NonFiniteGradientError as e:
+        print(f"RAISED consecutive={e.consecutive}", flush=True)
+    print("DONE", flush=True)
+    hvd.shutdown()
+
+
+def scenario_divergence_evict(hvd, fi):
+    """Elastic gang with a paced replica audit; one rank's plan flips a
+    bit of its audited state.  Every rank must reach the identical
+    verdict: the deviant is named, raises, and exits (exit 21); the
+    survivors re-form a smaller gang and finish."""
+    from horovod_tpu.common.types import ReplicaDivergenceError
+    from horovod_tpu.integrity import ReplicaAuditor
+
+    total = int(os.environ.get("INTEGRITY_TOTAL_STEPS", "8"))
+    auditor = ReplicaAuditor(
+        interval=int(os.environ.get("INTEGRITY_AUDIT_INTERVAL", "2")))
+    state = hvd.elastic.ObjectState(w=np.zeros(4, np.float32), step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < total:
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name=f"integrity.step{state.step}")
+            state.w = state.w + out
+            state.step += 1
+            state.commit()
+            try:
+                if auditor.maybe_audit({"w": state.w}):
+                    print(f"AUDIT_OK {state.step}", flush=True)
+            except ReplicaDivergenceError as e:
+                print(f"DIVERGENCE {json.dumps(e.ranks)} "
+                      f"leaf {e.leaf_path!r}", flush=True)
+                raise
+            print(f"STEP {state.step - 1} {float(state.w[0])}",
+                  flush=True)
+
+    try:
+        train(state)
+    except RuntimeError as e:
+        if "evicted" in str(e):
+            print("EVICTED", flush=True)
+            os._exit(21)
+        raise
+    print(f"FINAL_W {float(state.w[0])}", flush=True)
+    print(f"FINAL_SIZE {hvd.size()}", flush=True)
+    print("DONE", flush=True)
+    hvd.shutdown()
+
+
+SCENARIOS = {
+    "nonfinite_skip": scenario_nonfinite_skip,
+    "nonfinite_raise": scenario_nonfinite_raise,
+    "divergence_evict": scenario_divergence_evict,
+}
+
+
+def main():
+    name = sys.argv[1]
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection as fi
+
+    hvd.init()
+    SCENARIOS[name](hvd, fi)
+
+
+if __name__ == "__main__":
+    main()

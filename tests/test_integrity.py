@@ -1,0 +1,500 @@
+"""Data-plane integrity end-to-end (ISSUE 3 acceptance scenarios):
+
+(a) an injected NaN on ONE rank makes EVERY rank skip the SAME step —
+    parameters stay identical and the skip counters agree;
+(b) an injected bit flip is caught by the replica-divergence audit
+    within one audit interval, the error names the deviant rank, and the
+    elastic layer evicts it while the survivors re-form;
+(c) an injected checkpoint corruption makes the verified restore fall
+    back to the previous good checkpoint;
+(d) with no fault plan and the guard disabled (the default), the
+    optimizer hot path issues ZERO extra collectives (the zero-cost
+    pin, both regimes).
+
+Multi-process scenarios reuse the loopback-mesh harness idiom of
+tests/test_chaos.py / tests/test_elastic.py.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import fault_injection as fi
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "integrity_worker.py")
+
+HEARTBEAT_ENV = {"HVD_HEARTBEAT_TIMEOUT": "2.0",
+                 "HVD_HEARTBEAT_INTERVAL": "0.25"}
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: guard semantics (in-process, no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_resolution_and_validation(monkeypatch):
+    from horovod_tpu.integrity import nonfinite
+
+    assert nonfinite.resolve_policy(None) == "off"
+    monkeypatch.setenv("HVD_NONFINITE_POLICY", "SKIP")
+    assert nonfinite.resolve_policy(None) == "skip"
+    assert nonfinite.resolve_policy("zero") == "zero"  # arg beats env
+    with pytest.raises(ValueError, match="unknown non-finite policy"):
+        nonfinite.resolve_policy("bogus")
+    with pytest.raises(ValueError):
+        nonfinite.NonFiniteGuard("off")
+    with pytest.raises(ValueError):
+        nonfinite.consecutive_limit(0)
+
+
+def test_guard_rejects_unsupported_compositions():
+    import optax
+
+    import horovod_tpu as hvd
+
+    with pytest.raises(ValueError, match="eager-only"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), axis=("dp",),
+                                 nonfinite_policy="raise")
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), axis=None,
+                                 nonfinite_policy="skip",
+                                 backward_passes_per_step=2)
+
+
+def test_fingerprint_sensitivity():
+    from horovod_tpu.integrity import fingerprint
+
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.ones(3)}
+    folded, leaves = fingerprint(tree)
+    assert fingerprint(tree) == (folded, leaves)  # deterministic
+    # one-bit value change moves the digest
+    t2 = {"a": tree["a"].copy(), "b": tree["b"]}
+    t2["a"][0, 0] = np.nextafter(np.float32(0), np.float32(1))
+    assert fingerprint(t2)[0] != folded
+    # dtype drift with identical bytes-per-value count moves it too
+    t3 = {"a": tree["a"].view(np.int32), "b": tree["b"]}
+    assert fingerprint(t3)[0] != folded
+    # the state.bitflip site corrupts exactly one fingerprint call
+    fi.configure({"faults": [
+        {"site": "state.bitflip", "kind": "corrupt", "times": 1}]})
+    assert fingerprint(tree)[0] != folded
+    assert fingerprint(tree)[0] == folded  # times exhausted
+
+
+def test_replica_divergence_error_feeds_elastic():
+    import horovod_tpu as hvd
+
+    err = hvd.ReplicaDivergenceError([2], "['w']", {0: "aa", 2: "bb"})
+    assert isinstance(err, hvd.RanksFailedError)  # elastic catches it
+    assert err.ranks == [2]
+    assert "['w']" in str(err) and "diverged" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# (d) zero-cost pin: guard off => zero extra collectives
+# ---------------------------------------------------------------------------
+
+
+def test_zero_cost_pin_ingraph(jax, eight_devices):
+    """Policy 'off' must add NOTHING to the traced program; 'skip' adds
+    exactly one extra 1-element MAX-allreduce (pmax)."""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import make_mesh
+    from horovod_tpu.parallel.shard import shard_map
+
+    mesh = make_mesh({"dp": 8})
+    params = {"w": jnp.ones(8, jnp.float32)}
+
+    def count_pmax(policy):
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis=("dp",),
+                                       nonfinite_policy=policy)
+        opt_state = opt.init(params)
+
+        def upd(g):
+            u, _ = opt.update({"w": g}, opt_state, params)
+            return u["w"]
+
+        f = shard_map(upd, mesh, in_specs=P(), out_specs=P())
+        text = str(jax.make_jaxpr(f)(jnp.ones(8, jnp.float32)))
+        return text.count("pmax")
+
+    assert count_pmax("off") == 0          # the pin
+    assert count_pmax(None) == 0           # default == off
+    assert count_pmax("skip") == 1         # exactly the agreement
+
+
+def test_zero_cost_pin_eager(monkeypatch):
+    """Policy 'off' (and the default) must issue exactly the same engine
+    calls as the pre-guard optimizer: N allreduce_async for N leaves and
+    NOTHING else; 'skip' adds exactly one sync allreduce (the 1-element
+    agreement)."""
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import eager
+
+    hvd.shutdown()
+    hvd.init()
+    try:
+        calls = {"sync": 0, "async": 0}
+        real_allreduce = eager.allreduce
+        real_async = eager.allreduce_async
+
+        def spy_allreduce(*a, **k):
+            calls["sync"] += 1
+            return real_allreduce(*a, **k)
+
+        def spy_async(*a, **k):
+            calls["async"] += 1
+            return real_async(*a, **k)
+
+        monkeypatch.setattr(eager, "allreduce", spy_allreduce)
+        monkeypatch.setattr(eager, "allreduce_async", spy_async)
+
+        params = {"w": np.ones(4, np.float32), "b": np.ones(2, np.float32)}
+        grads = {"w": np.full(4, 0.5, np.float32),
+                 "b": np.full(2, 0.5, np.float32)}
+
+        def run_one(**kw):
+            calls["sync"] = calls["async"] = 0
+            opt = hvd.DistributedOptimizer(optax.sgd(0.1), axis=None, **kw)
+            opt.update(grads, opt.init(params), params)
+            return dict(calls)
+
+        baseline = run_one()
+        assert baseline == {"sync": 0, "async": 2}   # one per leaf
+        assert run_one(nonfinite_policy="off") == baseline  # the pin
+        # + exactly the 1-element agreement (eager.allreduce delegates
+        # to allreduce_async internally, so the spy counts it twice)
+        guarded = run_one(nonfinite_policy="skip")
+        assert guarded == {"sync": 1, "async": 3}
+    finally:
+        hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# (c) verified checkpoints: corrupt -> fallback (in-process)
+# ---------------------------------------------------------------------------
+
+
+def _ckpt():
+    pytest.importorskip("orbax.checkpoint")
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    return ckpt
+
+
+def test_save_verified_roundtrip_and_manifest(jax, tmp_path):
+    import jax.numpy as jnp
+
+    ckpt = _ckpt()
+    root = str(tmp_path / "ver")
+    tree = {"w": jnp.arange(8.0), "step": jnp.ones((), jnp.int32)}
+    final = ckpt.save_verified(root, tree, step=3)
+    assert final == os.path.join(root, "step_3")
+    ok, reason = ckpt.verify_checkpoint(final)
+    assert ok, reason
+    with open(ckpt.manifest_path(final)) as fh:
+        manifest = json.load(fh)
+    assert manifest["step"] == 3 and manifest["files"]
+    back, step = ckpt.restore_verified(root)
+    assert step == 3
+    np.testing.assert_allclose(np.asarray(back["w"]),
+                               np.asarray(tree["w"]))
+
+
+def test_ckpt_corrupt_falls_back_to_previous(jax, tmp_path):
+    """Acceptance (c): the newest checkpoint is corrupted after its
+    manifest is sealed (the ckpt.corrupt chaos site); restore must fall
+    back to the previous verified step."""
+    import jax.numpy as jnp
+
+    ckpt = _ckpt()
+    root = str(tmp_path / "ver")
+    ckpt.save_verified(root, {"w": jnp.full(8, 1.0)}, step=1)
+    fi.configure({"faults": [
+        {"site": "ckpt.corrupt", "kind": "corrupt", "times": 1}]})
+    ckpt.save_verified(root, {"w": jnp.full(8, 2.0)}, step=2)
+    fi.clear()
+    ok, reason = ckpt.verify_checkpoint(os.path.join(root, "step_2"))
+    assert not ok and "sha256 mismatch" in reason
+    back, step = ckpt.restore_verified(root)
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(back["w"]), 1.0)
+
+
+def test_ckpt_all_corrupt_raises_and_no_candidates(jax, tmp_path):
+    import jax.numpy as jnp
+
+    ckpt = _ckpt()
+    root = str(tmp_path / "ver")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_verified(root)
+    fi.configure({"faults": [
+        {"site": "ckpt.corrupt", "kind": "corrupt"}]})
+    ckpt.save_verified(root, {"w": jnp.full(8, 1.0)}, step=1)
+    ckpt.save_verified(root, {"w": jnp.full(8, 2.0)}, step=2)
+    fi.clear()
+    with pytest.raises(ckpt.CheckpointVerifyError, match="no verifiable"):
+        ckpt.restore_verified(root)
+
+
+def test_ckpt_keep_last_k_pruning(jax, tmp_path):
+    import jax.numpy as jnp
+
+    ckpt = _ckpt()
+    root = str(tmp_path / "ver")
+    for step in range(1, 6):
+        ckpt.save_verified(root, {"w": jnp.full(4, float(step))},
+                           step=step, keep=2)
+    steps = [s for s, _ in ckpt.list_steps(root)]
+    assert steps == [5, 4]
+    # pruned manifests are gone too
+    assert not os.path.exists(
+        ckpt.manifest_path(os.path.join(root, "step_1")))
+    back, step = ckpt.restore_verified(root)
+    assert step == 5
+
+
+# ---------------------------------------------------------------------------
+# checkpoint rank gating (satellite: unit coverage for save/resume paths)
+# ---------------------------------------------------------------------------
+
+
+class _FakeShardedLeaf:
+    class sharding:  # noqa: N801 — mimics jax.Array.sharding
+        num_devices = 8
+
+
+def test_save_rank_gating_replicated_vs_sharded(jax, tmp_path, monkeypatch):
+    ckpt = _ckpt()
+    from horovod_tpu import basics
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "rank", lambda: 1)
+    # Replicated tree on a non-root rank: gated out, nothing written.
+    path = str(tmp_path / "plain")
+    assert ckpt.save(path, {"w": np.ones(3)}) is False
+    assert not os.path.exists(path)
+    assert ckpt.save_verified(str(tmp_path / "ver"), {"w": np.ones(3)},
+                              step=1) is None
+    assert not os.path.exists(str(tmp_path / "ver"))
+    # A sharded tree disables the gating: every process must write.
+    writes = []
+
+    class StubCkptr:
+        def save(self, path, tree, force=True):
+            writes.append(str(path))
+
+        def wait_until_finished(self):
+            pass
+
+    import orbax.checkpoint as ocp
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", StubCkptr)
+    assert ckpt._is_sharded({"w": _FakeShardedLeaf()})
+    assert ckpt.save(str(tmp_path / "shard"), {"w": _FakeShardedLeaf()})
+    assert writes == [str(tmp_path / "shard")]
+
+
+def test_resume_or_init_broadcasts_only_fresh_init(jax, tmp_path,
+                                                   monkeypatch):
+    import jax.numpy as jnp
+
+    ckpt = _ckpt()
+    from horovod_tpu import basics
+    from horovod_tpu.ops import eager
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    casts = []
+    monkeypatch.setattr(
+        eager, "broadcast_parameters",
+        lambda tree, root, prefix="": casts.append((root, prefix)) or tree)
+
+    path = str(tmp_path / "ck")
+    fresh = ckpt.resume_or_init(path, lambda: {"w": jnp.full((2,), 7.0)})
+    np.testing.assert_allclose(np.asarray(fresh["w"]), 7.0)
+    assert casts == [(0, "ckpt.init")]  # fresh init: broadcast once
+    monkeypatch.setattr(basics, "is_initialized", lambda: False)
+    ckpt.save(path, {"w": jnp.full((2,), 9.0)})
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    resumed = ckpt.resume_or_init(path, lambda: {"w": jnp.full((2,), 7.0)})
+    np.testing.assert_allclose(np.asarray(resumed["w"]), 9.0)
+    assert casts == [(0, "ckpt.init")]  # restore path: NO broadcast
+    # broadcast=False opts the fresh-init path out too
+    ckpt.resume_or_init(str(tmp_path / "ck2"),
+                        lambda: {"w": jnp.full((2,), 7.0)},
+                        broadcast=False)
+    assert casts == [(0, "ckpt.init")]
+
+
+# ---------------------------------------------------------------------------
+# multi-process scenarios (a) and (b)
+# ---------------------------------------------------------------------------
+
+
+def run_integrity(scenario, np_, *, base_env=None, rank_env=None,
+                  elastic=False, timeout=150.0):
+    """Spawn an np_-rank gang of integrity_worker.py on the loopback
+    mesh (PyEngine) and return per-rank (exit_code, stdout, stderr)."""
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.pop(fi.ENV_VAR, None)
+            env["PYTHONPATH"] = (REPO + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_CROSS_RANK": "0",
+                "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_CORE": "py",
+            })
+            if elastic:
+                env.update({
+                    "HVD_ELASTIC_EPOCH": "0",
+                    "HVD_ELASTIC_MIN_NP": "2",
+                    "HVD_ELASTIC_MAX_NP": str(np_),
+                    "HVD_ELASTIC_UID": f"uid-{rank}",
+                    "HVD_ELASTIC_CHECK_INTERVAL_S": "0.05",
+                })
+                env.update(HEARTBEAT_ENV)
+            if base_env:
+                env.update(base_env)
+            if rank_env and rank in rank_env:
+                env.update(rank_env[rank])
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + timeout
+        outs = []
+        for p in procs:
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"integrity scenario {scenario}: worker timed out")
+            outs.append((p.returncode, out.decode(), err.decode()))
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def test_nonfinite_skip_agrees_across_ranks():
+    """Acceptance (a): rank 0's gradients are poisoned with NaN on step
+    2 only; BOTH ranks must skip exactly that step (counters agree) and
+    end with identical parameters — 5 applied sgd steps, not 6."""
+    plan = json.dumps({"faults": [
+        {"site": "grad.nonfinite", "kind": "corrupt",
+         "times": 1, "after": 2}]})
+    outs = run_integrity("nonfinite_skip", 2,
+                         rank_env={0: {fi.ENV_VAR: plan}})
+    finals = []
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+        assert "COUNTERS agreed=1 skipped=1" in out, (rank, out)
+        finals.append(re.search(r"FINAL_W ([\d.]+)", out).group(1))
+        # the skipped step leaves the parameter unchanged
+        steps = re.findall(r"STEP \d+ ([\d.]+) skipped=(\d+)", out)
+        assert steps[1][0] == steps[2][0], steps       # step 2 skipped
+        assert [s[1] for s in steps] == ["0", "0", "1", "1", "1", "1"]
+    # identical across ranks, and exactly 5 applied updates:
+    # 1.0 - 5 * (0.1 * 0.5) = 0.75
+    assert finals[0] == finals[1]
+    assert abs(float(finals[0]) - 0.75) < 1e-6, finals
+
+
+def test_nonfinite_raise_agrees_across_ranks():
+    """Policy 'raise' with limit 2: two consecutive poisoned steps on
+    rank 1 make EVERY rank raise together (rank 0 raises purely from the
+    MAX-allreduce agreement)."""
+    plan = json.dumps({"faults": [
+        {"site": "grad.nonfinite", "kind": "corrupt", "times": 2}]})
+    outs = run_integrity("nonfinite_raise", 2,
+                         rank_env={1: {fi.ENV_VAR: plan}})
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+        assert "RAISED consecutive=2" in out, (rank, out)
+
+
+def test_divergence_detected_and_deviant_evicted(tmp_path):
+    """Acceptance (b): rank 1's audited state digest is bit-flipped; the
+    first audit (one interval after the flip) detects it, every rank's
+    error names rank 1, the deviant exits evicted, and ranks 0+2 re-form
+    a 2-rank gang and finish the run."""
+    plan = json.dumps({"faults": [
+        {"site": "state.bitflip", "kind": "corrupt", "times": 1}]})
+    trace = str(tmp_path / "trace.json")
+    outs = run_integrity(
+        "divergence_evict", 3, elastic=True,
+        rank_env={0: {"HVD_TIMELINE": trace},
+                  1: {fi.ENV_VAR: plan}})
+
+    code1, out1, err1 = outs[1]
+    assert code1 == 21, (out1, err1)          # deviant self-evicts
+    assert "EVICTED" in out1
+    m = re.search(r"DIVERGENCE \[1\] leaf [\"'](.+)[\"']", out1)
+    assert m, out1                            # names itself + the leaf
+
+    for rank in (0, 2):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        assert "DIVERGENCE [1]" in out, (rank, out)  # identical verdict
+        assert "DONE" in out and "FINAL_SIZE 2" in out, (rank, out)
+    # the audit caught it within one interval: the survivors' audit at
+    # step 2 is the one that diverged (no AUDIT_OK before it)
+    assert "AUDIT_OK 2" not in outs[0][1]
+    # timeline records the detection and the re-form
+    with open(trace) as fh:
+        text = fh.read()
+    assert "DIVERGENCE_DETECTED" in text
+    assert "ELASTIC_REFORM" in text
+
+
+def test_divergence_audit_clean_run_passes():
+    """No fault plan: the same elastic scenario runs its audits clean at
+    full size (the audit itself must not perturb training)."""
+    outs = run_integrity("divergence_evict", 2, elastic=True,
+                         base_env={"INTEGRITY_TOTAL_STEPS": "4"})
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+        assert "AUDIT_OK 2" in out and "AUDIT_OK 4" in out, (rank, out)
+        assert "FINAL_SIZE 2" in out and "DONE" in out

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Lint: fault-injection sites, registry, and docs must agree.
+
+Three-way contract (wired into the suite as tests/test_fault_sites.py):
+
+1. every string-literal site passed to ``fire(...)`` /
+   ``should_corrupt(...)`` inside the ``horovod_tpu`` package must be
+   listed in ``fault_injection.KNOWN_SITES`` — an unregistered site is a
+   chaos hook nobody can discover or review;
+2. every registry entry must appear in the docs/fault_tolerance.md site
+   table (word-boundary match, same rule as tools/check_env_docs.py) —
+   the registry IS the user-facing surface of the chaos harness;
+3. the registry may list sites with no in-package caller (user-level
+   sites like ``train.step``, fired by training scripts), but never the
+   reverse.
+
+Call sites that compute the site name at runtime (e.g. the KV client's
+``kv.{verb}``) are invisible to the AST scan; the registry + docs checks
+still cover them, which is exactly why the registry exists.
+
+Usage: ``python tools/check_fault_sites.py`` (exit 1 on violations).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PKG_DIR = REPO_ROOT / "horovod_tpu"
+DOC_FILE = REPO_ROOT / "docs" / "fault_tolerance.md"
+
+_HOOKS = ("fire", "should_corrupt")
+
+
+def _called_hook(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _HOOKS
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in _HOOKS
+    return False
+
+
+def fired_literals(pkg_dir: Path = PKG_DIR) -> dict:
+    """``{site: [relpath, ...]}`` for every literal first argument to a
+    ``fire()`` / ``should_corrupt()`` call in the package."""
+    import os
+
+    out: dict = {}
+    for py in sorted(pkg_dir.rglob("*.py")):
+        tree = ast.parse(py.read_text(encoding="utf-8"))
+        rel = os.path.relpath(str(py), str(REPO_ROOT))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _called_hook(node)
+                    and node.args):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str):
+                out.setdefault(first.value, []).append(rel)
+    return out
+
+
+def registry() -> dict:
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        from horovod_tpu.common import fault_injection
+    finally:
+        sys.path.pop(0)
+    return fault_injection.known_sites()
+
+
+def unregistered_sites(pkg_dir: Path = PKG_DIR) -> dict:
+    known = registry()
+    return {s: files for s, files in fired_literals(pkg_dir).items()
+            if s not in known}
+
+
+def undocumented_sites(doc_file: Path = DOC_FILE) -> list:
+    text = doc_file.read_text(encoding="utf-8")
+    # Word-boundary match, dots escaped: ``kv.get`` must not be
+    # satisfied by ``kv.get.retry`` or a stray ``kv_get``.
+    return [s for s in sorted(registry())
+            if not re.search(rf"(?<![\w.]){re.escape(s)}(?![\w.])", text)]
+
+
+def main() -> int:
+    bad = False
+    unreg = unregistered_sites()
+    if unreg:
+        bad = True
+        print("fault-injection sites fired in code but missing from "
+              "fault_injection.KNOWN_SITES:", file=sys.stderr)
+        for site, files in sorted(unreg.items()):
+            print(f"  {site!r}  ({', '.join(sorted(set(files)))})",
+                  file=sys.stderr)
+    undoc = undocumented_sites()
+    if undoc:
+        bad = True
+        print("registered sites missing from the docs/fault_tolerance.md "
+              "site table:", file=sys.stderr)
+        for site in undoc:
+            print(f"  {site!r}", file=sys.stderr)
+    if bad:
+        print("add each site to KNOWN_SITES (common/fault_injection.py) "
+              "and to the site table in docs/fault_tolerance.md.",
+              file=sys.stderr)
+        return 1
+    print(f"ok: {len(registry())} fault sites registered and documented; "
+          f"{len(fired_literals())} literal call sites in the package")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
