@@ -138,9 +138,17 @@ class ReplicaAuditor:
 
     Call :meth:`maybe_audit` once per step on every rank; every
     ``interval`` steps (``HVD_AUDIT_INTERVAL``; 0 disables) it runs
-    :func:`audit_replicas`.  The pacing counter is local but advances in
-    lockstep (every rank steps together), so the collective fires on the
-    same step everywhere.
+    :func:`audit_replicas`.
+
+    Pass the gang-synchronized step (the committed ``state.step`` in an
+    elastic loop) as ``step`` — the audit fires when
+    ``step % interval == 0``, so every rank, *including a joiner whose
+    process just started*, paces off the same clock.  Without ``step``
+    the pacing falls back to a process-local call counter, which is only
+    safe when every rank's process has made the identical sequence of
+    calls (NOT true across an elastic re-form that admits a joiner: the
+    joiner's counter starts at 0 while incumbents are mid-interval, and
+    the collective allgather cross-matches or hangs).
     """
 
     def __init__(self, interval: Optional[int] = None):
@@ -151,13 +159,18 @@ class ReplicaAuditor:
         self.audits = 0     # audit rounds completed clean
         self._step = 0
 
-    def maybe_audit(self, tree) -> bool:
+    def maybe_audit(self, tree, step: Optional[int] = None) -> bool:
         """Returns True when an audit ran (and passed) this step."""
         if self.interval <= 0:
             return False
-        self._step += 1
-        if self._step % self.interval:
+        if step is None:
+            self._step += 1
+            step = self._step
+        else:
+            step = int(step)
+            self._step = step
+        if step % self.interval:
             return False
-        audit_replicas(tree, name=f"integrity.audit.{self._step}")
+        audit_replicas(tree, name=f"integrity.audit.{step}")
         self.audits += 1
         return True

@@ -141,6 +141,9 @@ class NonFiniteGuard:
     the caller must drop the step (zero updates, optimizer state
     untouched).  Collective: every rank must call it once per step, in
     step order — the agreement allreduce is named by an internal serial.
+    Host-side: it must see concrete gradients, so the guarded step runs
+    outside ``jit`` (traced leaves are rejected with a clear error; the
+    in-graph guard covers the jitted path).
     """
 
     def __init__(self, policy: Optional[str] = None,
@@ -157,14 +160,22 @@ class NonFiniteGuard:
         self._serial = 0
 
     def intercept(self, grads):
+        import jax
+
         from horovod_tpu.ops import eager
 
         self._serial += 1
+        leaves = jax.tree.leaves(grads)
+        if any(eager._is_traced(g) for g in leaves):
+            raise RuntimeError(
+                "NonFiniteGuard inspects gradients host-side and cannot "
+                "see traced values: call the guarded optimizer step "
+                "outside jit, or use the in-graph guard "
+                "(DistributedOptimizer(axis=..., nonfinite_policy=...))")
         if _fi.should_corrupt("grad.nonfinite", str(self._serial)):
             grads = _poison_first_float_leaf(grads)
-        import jax
-
-        local = _local_nonfinite(jax.tree.leaves(grads))
+            leaves = jax.tree.leaves(grads)
+        local = _local_nonfinite(leaves)
         flag = np.array([1 if local else 0], np.int32)
         agreed = eager.allreduce(
             flag, op=ReduceOp.MAX,
@@ -178,11 +189,11 @@ class NonFiniteGuard:
         if self.policy == "zero":
             import jax.numpy as jnp
 
+            # jnp (not np) so jax.Array leaves stay jax.Arrays.
             grads = jax.tree.map(
-                lambda g: np.where(np.isfinite(np.asarray(g)),
-                                   np.asarray(g), 0).astype(
-                    np.asarray(g).dtype)
-                if np.asarray(g).dtype.kind == "f" else g, grads)
+                lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g))
+                if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+                else g, grads)
             return grads, False
         self.skipped += 1
         _bump("skipped")

@@ -110,6 +110,15 @@ def _guarded_ingraph(inner, *, op, axis, compression, hierarchical,
     optimizer state (integrity.nonfinite.GuardState / stats())."""
     from horovod_tpu.integrity import nonfinite as _nf
 
+    # The flag agreement must span the FULL gradient-reduction set: with
+    # hierarchical=True the gradients reduce across the inner axes AND
+    # outer_axis (DCN), and a NaN agreed only within one slice would
+    # skip the step there while the other slices apply it — silently
+    # forking the replicas.
+    flag_axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    if hierarchical and outer_axis not in flag_axes:
+        flag_axes = flag_axes + (outer_axis,)
+
     def init_fn(params):
         return _nf.GuardState(jnp.zeros((), jnp.int32),
                               jnp.zeros((), jnp.int32),
@@ -122,7 +131,7 @@ def _guarded_ingraph(inner, *, op, axis, compression, hierarchical,
                 finite = jnp.logical_and(finite,
                                          jnp.all(jnp.isfinite(leaf)))
         flag = jnp.where(finite, 0, 1).astype(jnp.int32)
-        bad = C.allreduce(flag, op=ReduceOp.MAX, axis=axis)
+        bad = C.allreduce(flag, op=ReduceOp.MAX, axis=flag_axes)
         is_bad = bad > 0
 
         def reduce_and_apply(tree, inner_state):
@@ -184,7 +193,10 @@ def DistributedOptimizer(
     collectives.  Pass ``nonfinite_guard`` (a
     :class:`~horovod_tpu.integrity.nonfinite.NonFiniteGuard`) to keep a
     handle on the eager guard's counters.  Composes with
-    ``backward_passes_per_step == 1`` only.
+    ``backward_passes_per_step == 1`` only.  The eager guard inspects
+    gradients host-side: call the guarded step outside ``jit`` (the
+    bridge's traced-leaf path does not compose with a guard; the guard
+    raises a clear error on traced leaves).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")

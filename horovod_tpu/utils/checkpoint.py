@@ -251,13 +251,28 @@ def _prune(root: str, keep: int) -> None:
             pass
 
 
+def _gang_barrier() -> None:
+    from horovod_tpu import basics
+
+    if basics.is_initialized() and basics.size() > 1:
+        from horovod_tpu.ops import eager
+
+        eager.barrier()
+
+
 def save_verified(root: str, tree: Any, *, step: int,
                   keep: Optional[int] = None,
                   force: bool = True) -> Optional[str]:
     """Atomically write ``<root>/step_<step>`` + manifest; prune to the
     newest ``keep`` (``HVD_CKPT_KEEP``, default 3).  Returns the final
-    directory, or None on a non-writing (non-root, replicated) rank —
-    same gating and no-barrier caveat as :func:`save`.
+    directory, or None on a non-writing (non-root, replicated) rank.
+
+    Replicated trees keep :func:`save`'s rank-0-only gating (and its
+    no-barrier caveat).  Sharded trees are a *collective*: orbax requires
+    every process to pass the SAME directory, so the temp path is
+    deterministic (no pid) and the write is bracketed by gang barriers —
+    rank 0 seals (rename + manifest) only after every rank's shards are
+    on disk, and no rank returns before the seal is visible.
     """
     import orbax.checkpoint as ocp
 
@@ -271,19 +286,39 @@ def save_verified(root: str, tree: Any, *, step: int,
     sharded = _is_sharded(tree)
     if not sharded and basics.is_initialized() and basics.rank() != 0:
         return None
+    collective = sharded and basics.is_initialized() and basics.size() > 1
+    if sharded and not collective:
+        # Multi-process GSPMD without the engine: there is no barrier to
+        # order the collective shard write against the rank-0 seal, and
+        # a half-sealed checkpoint that *passes* verification is exactly
+        # what this layer exists to prevent.
+        import jax
+
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "save_verified on a multi-process sharded tree needs the "
+                "gang barrier that hvd.init() provides; initialize "
+                "horovod_tpu first or use the unverified save()")
+    if not force and os.path.isdir(final):
+        raise FileExistsError(final)
     os.makedirs(root, exist_ok=True)
-    tmp = os.path.join(root, f".tmp.step_{step}.{os.getpid()}")
-    shutil.rmtree(tmp, ignore_errors=True)
+    if collective:
+        tmp = os.path.join(root, f".tmp.step_{step}")
+        if basics.rank() == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        _gang_barrier()  # leftover tmp cleared before anyone writes
+    else:
+        tmp = os.path.join(root, f".tmp.step_{step}.{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(tmp, tree, force=True)
     ckptr.wait_until_finished()
+    if collective:
+        _gang_barrier()  # every rank's shards durable before the seal
     finalize = not (sharded and basics.is_initialized()
                     and basics.rank() != 0)
     if finalize:
         if os.path.isdir(final):
-            if not force:
-                shutil.rmtree(tmp, ignore_errors=True)
-                raise FileExistsError(final)
             shutil.rmtree(final)
         os.rename(tmp, final)
         epoch = env_util.get_int(env_util.ELASTIC_EPOCH, 0)
@@ -291,6 +326,8 @@ def save_verified(root: str, tree: Any, *, step: int,
         if _fi.should_corrupt("ckpt.corrupt", final):
             _corrupt_one_file(final)
         _prune(root, keep)
+    if collective:
+        _gang_barrier()  # the sealed dir is visible on every rank's return
     return final
 
 

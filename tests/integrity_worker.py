@@ -104,7 +104,10 @@ def scenario_divergence_evict(hvd, fi):
             state.step += 1
             state.commit()
             try:
-                if auditor.maybe_audit({"w": state.w}):
+                # Pace off the committed step, not the process-local
+                # counter — a joiner admitted mid-run starts at the
+                # gang's step, so the collective audit stays aligned.
+                if auditor.maybe_audit({"w": state.w}, step=state.step):
                     print(f"AUDIT_OK {state.step}", flush=True)
             except ReplicaDivergenceError as e:
                 print(f"DIVERGENCE {json.dumps(e.ranks)} "

@@ -97,6 +97,64 @@ def test_fingerprint_sensitivity():
     assert fingerprint(tree)[0] == folded  # times exhausted
 
 
+def test_guard_rejects_traced_gradients(jax):
+    """The eager guard is host-side: traced (inside-jit) gradients get a
+    clear error, not a ConcretizationTypeError from numpy."""
+    from horovod_tpu.integrity import nonfinite
+
+    guard = nonfinite.NonFiniteGuard("skip")
+    with pytest.raises(RuntimeError, match="host-side"):
+        jax.eval_shape(lambda g: guard.intercept({"w": g})[0]["w"],
+                       jax.ShapeDtypeStruct((4,), np.float32))
+
+
+def test_guard_zero_policy_preserves_jax_arrays(jax):
+    """The zero-policy sanitize must not silently convert jax.Arrays to
+    numpy (jnp.where, not np.where)."""
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.integrity import nonfinite
+
+    hvd.shutdown()
+    hvd.init()
+    try:
+        guard = nonfinite.NonFiniteGuard("zero")
+        grads = {"w": jnp.array([1.0, np.nan, np.inf], jnp.float32),
+                 "n": np.arange(3)}
+        out, skip = guard.intercept(grads)
+        assert not skip
+        assert isinstance(out["w"], jax.Array)
+        np.testing.assert_allclose(np.asarray(out["w"]), [1.0, 0.0, 0.0])
+        assert isinstance(out["n"], np.ndarray)  # non-float untouched
+    finally:
+        hvd.shutdown()
+
+
+def test_auditor_paces_off_committed_step(monkeypatch):
+    """A joiner's fresh auditor must agree with an incumbent's on WHICH
+    step audits when both are fed the gang-synchronized step — the
+    process-local call count must not matter."""
+    from horovod_tpu.integrity import audit as audit_mod
+
+    ran = []
+    monkeypatch.setattr(audit_mod, "audit_replicas",
+                        lambda tree, name="": ran.append(name) or 0)
+    incumbent = audit_mod.ReplicaAuditor(interval=3)
+    joiner = audit_mod.ReplicaAuditor(interval=3)
+    for step in range(1, 5):                  # incumbent saw steps 1..4
+        incumbent.maybe_audit({}, step=step)
+    # joiner admitted at step 5: first-ever call, mid-interval
+    for step in (5, 6):
+        a = incumbent.maybe_audit({}, step=step)
+        b = joiner.maybe_audit({}, step=step)
+        assert a == b == (step % 3 == 0)
+    assert incumbent.audits == 2 and joiner.audits == 1
+    # the collective name is step-derived, so it matches across ranks
+    assert ran == ["integrity.audit.3", "integrity.audit.6",
+                   "integrity.audit.6"]
+
+
 def test_replica_divergence_error_feeds_elastic():
     import horovod_tpu as hvd
 
@@ -141,6 +199,44 @@ def test_zero_cost_pin_ingraph(jax, eight_devices):
     assert count_pmax("off") == 0          # the pin
     assert count_pmax(None) == 0           # default == off
     assert count_pmax("skip") == 1         # exactly the agreement
+
+
+def test_guarded_hierarchical_agreement_spans_dcn(jax, eight_devices):
+    """A NaN on ONE dcn slice must skip the step on EVERY slice: the
+    flag agreement spans the full reduction set (inner axes AND
+    outer_axis), otherwise the slices silently fork."""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.parallel import make_mesh
+    from horovod_tpu.parallel.shard import shard_map
+
+    mesh = make_mesh({"dcn": 2, "dp": 4})
+    params = {"w": jnp.zeros(16, jnp.float32)}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), axis=("dp", "dcn"),
+                                   hierarchical=True,
+                                   nonfinite_policy="skip")
+    state = opt.init(params)
+
+    def body(g):
+        # Poison exactly one shard (dcn slice 0, dp shard 0).
+        poisoned = jnp.where(C.axis_index(("dcn", "dp")) == 0,
+                             jnp.full_like(g, jnp.nan), g)
+        upd, new_state = opt.update({"w": poisoned}, state, params)
+        return upd["w"], new_state.nonfinite_steps
+
+    f = shard_map(body, mesh, in_specs=P(), out_specs=(P(), P()))
+    # the agreement is ONE pmax, and it covers both mesh axes
+    text = str(jax.make_jaxpr(f)(jnp.ones(16, jnp.float32)))
+    m = re.search(r"pmax\[(.*?)\]", text, re.S)
+    assert text.count("pmax") == 1, text
+    assert m and "dp" in m.group(1) and "dcn" in m.group(1), text
+    upd, skips = f(jnp.ones(16, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(upd), 0.0)  # all slices skip
+    assert int(np.asarray(skips)) == 1
 
 
 def test_zero_cost_pin_eager(monkeypatch):
@@ -315,6 +411,69 @@ def test_save_rank_gating_replicated_vs_sharded(jax, tmp_path, monkeypatch):
     assert ckpt._is_sharded({"w": _FakeShardedLeaf()})
     assert ckpt.save(str(tmp_path / "shard"), {"w": _FakeShardedLeaf()})
     assert writes == [str(tmp_path / "shard")]
+
+
+def test_save_verified_sharded_collective_shared_tmp_and_barriers(
+        jax, tmp_path, monkeypatch):
+    """Sharded (GSPMD) trees: orbax's save is a collective, so every
+    process must write into the SAME tmp dir (no pid suffix), the rank-0
+    seal must wait on gang barriers for every rank's shards, and only
+    rank 0 writes the manifest."""
+    ckpt = _ckpt()
+    from horovod_tpu import basics
+
+    barriers = []
+    monkeypatch.setattr(ckpt, "_gang_barrier",
+                        lambda: barriers.append(True))
+    saved = []
+
+    class StubCkptr:
+        def save(self, path, tree, force=True):
+            saved.append(str(path))
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "shard"), "wb") as fh:
+                fh.write(b"data")
+
+        def wait_until_finished(self):
+            pass
+
+    import orbax.checkpoint as ocp
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", StubCkptr)
+    monkeypatch.setattr(basics, "is_initialized", lambda: True)
+    monkeypatch.setattr(basics, "size", lambda: 2)
+    tree = {"w": _FakeShardedLeaf()}
+    root = str(tmp_path / "shard")
+    shared_tmp = os.path.join(root, ".tmp.step_7")
+
+    monkeypatch.setattr(basics, "rank", lambda: 1)
+    final = ckpt.save_verified(root, tree, step=7)
+    assert final == os.path.join(root, "step_7")
+    assert saved == [shared_tmp]                      # no pid suffix
+    assert not os.path.exists(ckpt.manifest_path(final))  # rank 1: no seal
+    assert len(barriers) == 3
+
+    monkeypatch.setattr(basics, "rank", lambda: 0)
+    final = ckpt.save_verified(root, tree, step=7)
+    assert saved[-1] == shared_tmp                    # same shared dir
+    ok, reason = ckpt.verify_checkpoint(final)
+    assert ok, reason
+    assert not os.path.exists(shared_tmp)             # sealed, no leak
+    assert len(barriers) == 6
+
+
+def test_save_verified_multiprocess_sharded_needs_engine(jax, tmp_path,
+                                                         monkeypatch):
+    """Without the engine there is no barrier to order the collective
+    shard write against the rank-0 seal: refuse, loudly."""
+    ckpt = _ckpt()
+    from horovod_tpu import basics
+
+    monkeypatch.setattr(basics, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(RuntimeError, match="gang barrier"):
+        ckpt.save_verified(str(tmp_path / "v"),
+                           {"w": _FakeShardedLeaf()}, step=1)
 
 
 def test_resume_or_init_broadcasts_only_fresh_init(jax, tmp_path,
