@@ -45,6 +45,7 @@ from horovod_tpu.basics import (  # noqa: F401
     is_initialized,
     local_rank,
     local_size,
+    metrics_snapshot,
     mpi_built,
     mpi_enabled,
     mpi_threads_supported,
@@ -91,6 +92,7 @@ from horovod_tpu.parallel.optimizer import (  # noqa: F401
 from horovod_tpu import data  # noqa: F401  (sharded sampling + prefetch)
 from horovod_tpu import elastic  # noqa: F401  (commit/rollback + re-form)
 from horovod_tpu import integrity  # noqa: F401  (data-plane integrity)
+from horovod_tpu import telemetry  # noqa: F401  (metrics registry/export)
 from horovod_tpu.parallel.multihost import (  # noqa: F401
     init_jax_distributed,
 )

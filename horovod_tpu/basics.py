@@ -93,6 +93,13 @@ def init(
             _runtime = SingleProcessEngine()
         else:
             _runtime = _make_engine(r, s, lr, ls, cr, cs)
+        # Telemetry (docs/metrics.md): the Python engines start it in
+        # their own __init__ (direct construction in tests included);
+        # this idempotent call covers the native engine too, so the
+        # eager-layer collective metrics work under either core.
+        from horovod_tpu import telemetry
+
+        telemetry.init_from_env(r, lr or 0)
 
 
 def _make_engine(r, s, lr, ls, cr, cs):
@@ -144,6 +151,12 @@ def shutdown() -> None:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
+    # Stop the metrics server/flusher (final flush included).  The
+    # registry itself keeps counting: an elastic re-form calls
+    # shutdown() + init() in the same process and the counters span it.
+    from horovod_tpu import telemetry
+
+    telemetry.stop()
 
 
 def rank() -> int:
@@ -217,3 +230,14 @@ def cache_stats() -> dict:
     timeline read equivalent internals; this is the observable surface
     for tests and tuning."""
     return _engine().cache_stats()
+
+
+def metrics_snapshot() -> dict:
+    """JSON-serializable view of this worker's telemetry registry
+    (docs/metrics.md): ``{"counters": ..., "gauges": ...,
+    "histograms": ...}`` with Prometheus-style series keys, or ``{}``
+    when telemetry is off.  Process-global, not engine-bound — counters
+    accumulate across elastic engine resets."""
+    from horovod_tpu import telemetry
+
+    return telemetry.snapshot()

@@ -59,6 +59,7 @@ from horovod_tpu.elastic.driver import (
     HostDiscoveryScript,
     HostsUpdatedInterrupt,
 )
+from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
@@ -255,6 +256,7 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     ctx.roster = world
     ctx.consume_updates()
     ctx.maybe_start_driver()
+    _tmx.inc_counter("hvd_elastic_reforms_total")
     _timeline_event("ELASTIC_REFORM", epoch=new_epoch, size=len(world))
     ctx.log.info("gang re-formed: epoch %d, rank %d/%d",
                  new_epoch, new_rank, len(world))

@@ -49,6 +49,7 @@ import numpy as np
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import timeline as timeline_mod
 
@@ -197,6 +198,7 @@ class NonFiniteGuard:
             return grads, False
         self.skipped += 1
         _bump("skipped")
+        _tmx.inc_counter("hvd_nonfinite_skips_total")
         timeline_mod.engine_event(
             timeline_mod.NONFINITE_SKIP, serial=self._serial,
             policy=self.policy, consecutive=self.consecutive)

@@ -15,12 +15,14 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from horovod_tpu import basics
 from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.telemetry import registry as _tmx
 
 _counter_lock = threading.Lock()
 _op_counters: Dict[str, int] = {}
@@ -91,6 +93,30 @@ def _register(handle: int, fn: Callable) -> int:
     return handle
 
 
+def _timed_post(kind: str, arr: np.ndarray,
+                post: Optional[Callable]) -> Optional[Callable]:
+    """Per-collective telemetry (docs/metrics.md): count + input bytes at
+    enqueue, enqueue-to-completion latency observed when the handle's
+    postprocess runs in ``synchronize``.  With telemetry off this returns
+    ``post`` untouched — the labels and closure below are the allocating
+    part, and they only exist behind the ``enabled()`` check.  The jit
+    bridge funnels through these same async ops (io_callback →
+    *_async), so both entry points are covered."""
+    if not _tmx.enabled():
+        return post
+    labels = (kind, str(arr.dtype))
+    _tmx.inc_counter("hvd_collectives_total", labels=labels)
+    _tmx.observe("hvd_collective_bytes", arr.nbytes, labels=labels)
+    t0 = time.monotonic()
+
+    def timed(raw):
+        _tmx.observe("hvd_collective_latency_seconds",
+                     time.monotonic() - t0, labels=labels)
+        return post(raw) if post is not None else raw
+
+    return timed
+
+
 def poll(handle: int) -> bool:
     return basics._engine().poll(handle)
 
@@ -141,7 +167,7 @@ def allreduce_async(tensor, average: Optional[bool] = None,
         raw = _np_decompress(compression, raw, ctx)
         return restore(raw)
 
-    return _register(h, post)
+    return _register(h, _timed_post("allreduce", comp_arr, post))
 
 
 def _np_compress(compression, arr):
@@ -222,7 +248,7 @@ def allgather_async(tensor, name: Optional[str] = None,
     arr, restore = _to_numpy(tensor)
     h = basics._engine().allgather_async(
         _auto_name("allgather", name), arr, process_set=process_set)
-    return _register(h, restore)
+    return _register(h, _timed_post("allgather", arr, restore))
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
@@ -282,7 +308,7 @@ def reducescatter_async(tensor, average: Optional[bool] = None,
     h = basics._engine().reducescatter_async(
         _auto_name("reducescatter", name), arr, op=rop,
         process_set=process_set)
-    return _register(h, restore)
+    return _register(h, _timed_post("reducescatter", arr, restore))
 
 
 def reducescatter(tensor, average: Optional[bool] = None,
@@ -304,7 +330,7 @@ def broadcast_async(tensor, root_rank: int = 0,
     h = basics._engine().broadcast_async(
         _auto_name("broadcast", name), arr, root_rank=root_rank,
         process_set=process_set)
-    return _register(h, restore)
+    return _register(h, _timed_post("broadcast", arr, restore))
 
 
 def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None,
@@ -333,7 +359,7 @@ def alltoall_async(tensor, splits=None, name: Optional[str] = None,
             return restore(data), recv_splits
         return restore(raw)
 
-    return _register(h, post)
+    return _register(h, _timed_post("alltoall", arr, post))
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,

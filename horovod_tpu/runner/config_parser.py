@@ -31,6 +31,7 @@ _ARG_ENV = {
     "min_np": E.ELASTIC_MIN_NP,
     "max_np": E.ELASTIC_MAX_NP,
     "host_discovery_script": E.HOST_DISCOVERY_SCRIPT,
+    "metrics_port": E.METRICS_PORT,
 }
 
 _MB = {"fusion_threshold_mb"}

@@ -28,7 +28,14 @@ from typing import Optional
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.retry import retry_call
 from horovod_tpu.runner import secret as secret_mod
+from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
+
+
+def _count_retry(attempt_index: int, exc: BaseException) -> None:
+    # Invoked by retry_call before each backoff sleep; a no-op load +
+    # None check when telemetry is off.
+    _tmx.inc_counter("hvd_kv_retries_total")
 
 
 def _retryable(e: BaseException) -> bool:
@@ -71,7 +78,7 @@ class KVClient:
         return retry_call(
             attempt, attempts=self.attempts,
             base_delay=self.retry_base, max_delay=self.retry_max,
-            is_retryable=_retryable,
+            is_retryable=_retryable, on_retry=_count_retry,
             seed=zlib.crc32(key.encode("utf-8")))
 
     def put(self, key: str, value) -> None:

@@ -233,6 +233,12 @@ def make_parser() -> argparse.ArgumentParser:
     st.add_argument("--stall-shutdown-time-seconds", type=float,
                     dest="stall_shutdown_time_seconds")
 
+    mx = p.add_argument_group("metrics")
+    mx.add_argument("--metrics-port", type=int, dest="metrics_port",
+                    help="per-worker metrics debug-server base port "
+                         "(worker binds port + local_rank; "
+                         "see docs/metrics.md)")
+
     p.add_argument("--log-level", dest="log_level",
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -281,6 +287,12 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               f"--launcher {args.launcher} (the external scheduler owns "
               "the job lifecycle; use its requeue policy)",
               file=sys.stderr)
+        return 2
+    if args.metrics_port is not None and \
+            not (1 <= args.metrics_port <= 65535):
+        print(f"{_prog_name()}: --metrics-port must be in 1..65535 "
+              f"(got {args.metrics_port}); each worker binds "
+              "metrics-port + local_rank", file=sys.stderr)
         return 2
     # Elastic flags: validate at parse time, before any rendezvous/ssh
     # side effects — a bad floor/ceiling or a missing discovery script

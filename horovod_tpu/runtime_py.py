@@ -55,6 +55,8 @@ from horovod_tpu.common.types import (
     TensorShape,
 )
 from horovod_tpu.common.types import dtype_from_numpy, dtype_to_numpy_name
+from horovod_tpu import telemetry as _telemetry
+from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 from horovod_tpu.utils import timeline as timeline_mod
@@ -225,6 +227,7 @@ class SingleProcessEngine(_EngineBase):
     def __init__(self):
         super().__init__(0, 1, 0, 1, 0, 1)
         self.timeline = timeline_mod.from_env(0)
+        _telemetry.init_from_env(0, 0)
 
     def shutdown(self):
         self.timeline.shutdown()
@@ -327,6 +330,20 @@ class PyEngine(_EngineBase):
         # or rejected (coordinator) so a zombie rank from a previous gang
         # cannot corrupt this one's negotiation.
         self.epoch = env_util.get_int(env_util.ELASTIC_EPOCH, 0)
+
+        # Telemetry (horovod_tpu.telemetry; docs/metrics.md).  The
+        # registry hooks are zero-cost when off, but call sites whose
+        # arguments allocate guard on this flag.  The straggler detector
+        # is coordinator-only: it folds the per-rank ready ticks the
+        # coordinator already sees into a skew histogram.
+        self._metrics_on = _telemetry.init_from_env(rank, local_rank)
+        self._straggler = None
+        if self._metrics_on:
+            _tmx.set_gauge("hvd_elastic_epoch", self.epoch)
+            if rank == 0:
+                self._straggler = _telemetry.StragglerDetector(
+                    env_util.get_float(env_util.STRAGGLER_WARN_MS, 0.0),
+                    size)
 
         # request queue (tensor queue) + tensor table
         self._queue_lock = threading.Lock()
@@ -669,6 +686,8 @@ class PyEngine(_EngineBase):
                 if not self._run_loop_once():
                     break
                 dt = time.monotonic() - t0
+                _tmx.inc_counter("hvd_cycles_total")
+                _tmx.observe("hvd_cycle_duration_seconds", dt)
                 if dt < self.cycle_time:
                     time.sleep(self.cycle_time - dt)
         except Exception as e:  # deliver failure to all pending handles
@@ -701,6 +720,7 @@ class PyEngine(_EngineBase):
         with self._queue_lock:
             msgs = self._request_queue
             self._request_queue = []
+        _tmx.set_gauge("hvd_queue_depth", len(msgs))
         if self.rank == 0:
             return self._coordinator_cycle(msgs)
         return self._worker_cycle(msgs)
@@ -713,6 +733,7 @@ class PyEngine(_EngineBase):
         (controller.cc:171-200)."""
         requests: List[Request] = []
         hits: List[tuple] = []
+        misses = 0
         for req in msgs:
             if req.tensor_name in self._resend_uncached:
                 self._resend_uncached.discard(req.tensor_name)
@@ -726,6 +747,11 @@ class PyEngine(_EngineBase):
                 hits.append((req.tensor_name, pos))
             else:
                 requests.append(req)
+                misses += 1
+        if hits:
+            _tmx.inc_counter("hvd_cache_hits_total", len(hits))
+        if misses:
+            _tmx.inc_counter("hvd_cache_misses_total", misses)
         return requests, hits
 
     def _execute_cached_hits(self, hit_positions: List[int]) -> None:
@@ -879,6 +905,9 @@ class PyEngine(_EngineBase):
                         req.tensor_name, _OP_NAMES[req.request_type])
                 self.timeline.negotiate_rank_ready(
                     req.tensor_name, req.request_rank)
+            if self._straggler is not None:
+                self._straggler.note_ready(
+                    _MessageTable.key_of(req), req.request_rank)
             if self._msg_table.increment(req, len(self._joined_ranks)):
                 ready.append(_MessageTable.key_of(req))
 
@@ -931,10 +960,19 @@ class PyEngine(_EngineBase):
         responses: List[Response] = []
         hit_positions: List[int] = []
         for key in ready:
+            t_first = self._msg_table.first_seen.get(key) \
+                if self._metrics_on else None
             reqs = self._msg_table.pop(key)
             name = reqs[0].tensor_name  # key may be set-scoped
             if self.timeline.enabled:
                 self.timeline.negotiate_end(name)
+            if t_first is not None:
+                _tmx.observe("hvd_negotiation_seconds",
+                             time.monotonic() - t_first)
+            if self._straggler is not None:
+                lagger = self._straggler.note_complete(key)
+                if lagger is not None:
+                    self._emit_straggler(name, *lagger)
             # Hits are global-set-only, where key == name; popping by key
             # keeps a set-scoped completion from stealing a same-named
             # global tensor's hit record.
@@ -976,6 +1014,15 @@ class PyEngine(_EngineBase):
         if responses or hit_positions or resend_by_rank or shutdown \
                 or tuned is not None:
             fused = self._fuse_responses(responses)
+            if self._metrics_on:
+                for resp in fused:
+                    if resp.tensor_names and resp.tensor_type is not None:
+                        _tmx.observe(
+                            "hvd_fused_bytes",
+                            sum(resp.tensor_sizes)
+                            * resp.tensor_type.itemsize)
+                        _tmx.observe("hvd_fused_tensors",
+                                     len(resp.tensor_names))
             params = None
             if tuned is not None:
                 params = (tuned.fusion_threshold, tuned.cycle_time_s,
@@ -1051,6 +1098,9 @@ class PyEngine(_EngineBase):
                 "rank %d unresponsive (%s); evicting from the job", r,
                 "connection lost" if r in self._conn_lost
                 else f"no heartbeat for {self.heartbeat_timeout:.1f}s")
+            if r not in self._conn_lost:
+                _tmx.inc_counter("hvd_heartbeat_misses_total")
+            _tmx.inc_counter("hvd_evictions_total")
             self._evicted_ranks.add(r)
             self._joined_ranks.add(r)
         for nm, lst in list(self._msg_table.entries.items()):
@@ -1061,12 +1111,28 @@ class PyEngine(_EngineBase):
                 # entry, so nothing to complete.
                 self._msg_table.pop(nm)
                 self._hit_ranks.pop(nm, None)
+                if self._straggler is not None:
+                    self._straggler.forget(nm)
                 if nm in ready:
                     ready.remove(nm)
             elif lst[0].process_set_id == 0 and \
                     len(lst) == self.size - len(self._joined_ranks) and \
                     nm not in ready:
                 ready.append(nm)
+
+    def _emit_straggler(self, name: str, lag_rank: int,
+                        skew_s: float) -> None:
+        """The straggler detector tripped: one rank has been last to
+        negotiate for several consecutive tensors by more than
+        HVD_STRAGGLER_WARN_MS.  Record it on the timeline and warn; the
+        detector re-arms, so records are naturally throttled."""
+        self.log.warning(
+            "straggler: rank %d consistently last to negotiate "
+            "(skew %.1f ms on %s)", lag_rank, skew_s * 1e3, name)
+        if self.timeline.enabled:
+            self.timeline.instant(
+                timeline_mod.STRAGGLER, rank=lag_rank,
+                skew_ms=round(skew_s * 1e3, 3), tensor=name)
 
     def _check_stalls(self) -> bool:
         now = time.monotonic()
@@ -1085,6 +1151,7 @@ class PyEngine(_EngineBase):
                 self.log.warning(
                     "Stalled tensor %s: ready on ranks %s, waiting on %s "
                     "for %.0fs", name, have, missing, waited)
+                _tmx.inc_counter("hvd_stall_warnings_total")
                 if self.stall_shutdown_s > 0 and \
                         waited > self.stall_shutdown_s:
                     self.log.error(

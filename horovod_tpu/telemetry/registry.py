@@ -1,0 +1,320 @@
+"""Lock-cheap metrics registry: counters, gauges, log2 histograms.
+
+Design goals, in order:
+
+1. **Provably zero-cost when off.**  The module-level hooks
+   (``inc_counter`` / ``set_gauge`` / ``observe``) do a single global
+   load + ``None`` check and return — the same contract as
+   ``fault_injection.fire`` — so instrumenting a hot path costs one
+   function call and zero allocations when ``HVD_METRICS`` is unset
+   (pinned by tests/test_telemetry.py, mirroring the chaos harness pin).
+   Call sites whose *arguments* would allocate (dynamic label values,
+   byte counts) guard on ``enabled()`` first.
+2. **Central registry.**  Every metric name must be declared in
+   ``KNOWN_METRICS`` before use — an undeclared name raises when the
+   registry is on.  ``tools/check_metric_docs.py`` lints that every
+   registered name is documented in docs/metrics.md, the same three-way
+   contract as the fault-site registry (tools/check_fault_sites.py).
+3. **One lock, fixed buckets.**  A single ``threading.Lock`` guards all
+   series (contention is negligible next to the socket work the
+   instrumented paths do).  Histograms use fixed log2 bucket bounds
+   (``lo * 2**i``), so an observation is a ``bisect`` + increment — no
+   per-observation allocation, and buckets line up across ranks for
+   aggregation.
+
+Prometheus text exposition follows the v0.0.4 format: ``# HELP`` /
+``# TYPE`` headers, cumulative ``_bucket{le=...}`` series plus ``_sum`` /
+``_count`` for histograms.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, Optional, Tuple
+
+
+def log2_buckets(lo: float, n: int) -> Tuple[float, ...]:
+    """``n`` upper bounds ``lo * 2**i`` (the +Inf bucket is implicit)."""
+    return tuple(lo * (2.0 ** i) for i in range(n))
+
+
+def _counter(help_: str, labels: Tuple[str, ...] = ()) -> dict:
+    return {"kind": "counter", "help": help_, "labels": labels}
+
+
+def _gauge(help_: str, labels: Tuple[str, ...] = ()) -> dict:
+    return {"kind": "gauge", "help": help_, "labels": labels}
+
+
+def _hist(help_: str, lo: float, n: int,
+          labels: Tuple[str, ...] = ()) -> dict:
+    return {"kind": "histogram", "help": help_, "labels": labels,
+            "buckets": log2_buckets(lo, n)}
+
+
+# Bucket families: latencies span 0.5 ms .. ~16 s; sizes span
+# 256 B .. 128 MB (the default fusion threshold is 64 MB).
+_SECONDS = (0.0005, 16)
+_BYTES = (256.0, 20)
+
+# The registry: every metric the package emits, with kind, help text,
+# label names, and (for histograms) bucket bounds.  Keep alphabetized
+# within each group; docs/metrics.md must list every name here
+# (tools/check_metric_docs.py enforces it).
+KNOWN_METRICS: Dict[str, dict] = {
+    # -- engine coordination (runtime_py.py) --
+    "hvd_cycles_total": _counter(
+        "Background coordination cycles run."),
+    "hvd_cycle_duration_seconds": _hist(
+        "Wall time of one coordination cycle.", *_SECONDS),
+    "hvd_negotiation_seconds": _hist(
+        "Per-tensor negotiation latency: first rank ready to globally "
+        "ready.", *_SECONDS),
+    "hvd_queue_depth": _gauge(
+        "Requests waiting in the engine message queue at cycle start."),
+    "hvd_fused_bytes": _hist(
+        "Payload bytes per fused response batch.", *_BYTES),
+    "hvd_fused_tensors": _hist(
+        "Tensors per fused response batch.", 1.0, 10),
+    "hvd_stall_warnings_total": _counter(
+        "Stalled-tensor warnings issued by the stall inspector."),
+    # -- collectives (ops/eager.py; the jit bridge funnels through the
+    #    same eager machinery, so these cover both entry points) --
+    "hvd_collectives_total": _counter(
+        "Collective operations completed.", ("op", "dtype")),
+    "hvd_collective_bytes": _hist(
+        "Input payload bytes per collective.", *_BYTES,
+        labels=("op", "dtype")),
+    "hvd_collective_latency_seconds": _hist(
+        "Enqueue-to-completion latency per collective.", *_SECONDS,
+        labels=("op", "dtype")),
+    # -- response cache (common/response_cache.py via the engine) --
+    "hvd_cache_hits_total": _counter(
+        "Response-cache hits in request classification."),
+    "hvd_cache_misses_total": _counter(
+        "Response-cache misses (full negotiation taken)."),
+    # -- robustness layers --
+    "hvd_heartbeat_misses_total": _counter(
+        "Ranks declared dead by the heartbeat timeout."),
+    "hvd_evictions_total": _counter(
+        "Dead ranks evicted via the Join machinery."),
+    "hvd_kv_retries_total": _counter(
+        "Rendezvous KV client request retries."),
+    "hvd_elastic_epoch": _gauge(
+        "Current elastic membership epoch."),
+    "hvd_elastic_reforms_total": _counter(
+        "Successful elastic gang re-forms."),
+    "hvd_nonfinite_skips_total": _counter(
+        "Steps skipped by the agreed non-finite gradient guard."),
+    # -- straggler detection (telemetry/straggler.py) --
+    "hvd_straggler_skew_seconds": _hist(
+        "Negotiation skew: last rank ready minus first rank ready, "
+        "labeled by the last rank.", *_SECONDS, labels=("rank",)),
+    "hvd_straggler_events_total": _counter(
+        "STRAGGLER records emitted (rank consistently last beyond "
+        "HVD_STRAGGLER_WARN_MS).", ("rank",)),
+}
+
+
+class Registry:
+    """All live series for one process.  Series are keyed by
+    ``(name, label_values)``; label values arrive as a tuple ordered
+    like the spec's label names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[tuple, float] = {}
+        self._gauges: Dict[tuple, float] = {}
+        # (name, labels) -> [bucket_counts..., inf_count, sum, count]
+        self._hists: Dict[tuple, list] = {}
+
+    @staticmethod
+    def _spec(name: str, kind: str) -> dict:
+        spec = KNOWN_METRICS.get(name)
+        if spec is None:
+            raise KeyError(
+                f"metric {name!r} is not declared in KNOWN_METRICS "
+                "(horovod_tpu/telemetry/registry.py) — declare it and "
+                "document it in docs/metrics.md")
+        if spec["kind"] != kind:
+            raise TypeError(
+                f"metric {name!r} is a {spec['kind']}, not a {kind}")
+        return spec
+
+    def inc_counter(self, name: str, value: float = 1.0,
+                    labels: tuple = ()) -> None:
+        self._spec(name, "counter")
+        key = (name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float,
+                  labels: tuple = ()) -> None:
+        self._spec(name, "gauge")
+        with self._lock:
+            self._gauges[(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float,
+                labels: tuple = ()) -> None:
+        spec = self._spec(name, "histogram")
+        bounds = spec["buckets"]
+        idx = bisect_left(bounds, value)  # == len(bounds) -> +Inf bucket
+        key = (name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [0] * (len(bounds) + 1) + [0.0, 0]
+            h[idx] += 1
+            h[-2] += value
+            h[-1] += 1
+
+    # -- export ----------------------------------------------------------
+
+    @staticmethod
+    def _series(name: str, labels: tuple) -> str:
+        if not labels:
+            return name
+        names = KNOWN_METRICS[name]["labels"]
+        inner = ",".join(f'{k}="{v}"' for k, v in zip(names, labels))
+        return f"{name}{{{inner}}}"
+
+    def snapshot(self) -> dict:
+        """JSON-serializable view: Prometheus-style series keys so tests
+        and offline analysis can match a labeled series by name."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: list(v) for k, v in self._hists.items()}
+        out = {"counters": {}, "gauges": {}, "histograms": {}}
+        for (name, labels), v in sorted(counters.items()):
+            out["counters"][self._series(name, labels)] = v
+        for (name, labels), v in sorted(gauges.items()):
+            out["gauges"][self._series(name, labels)] = v
+        for (name, labels), h in sorted(hists.items()):
+            bounds = KNOWN_METRICS[name]["buckets"]
+            buckets = {_fmt(b): h[i] for i, b in enumerate(bounds)}
+            buckets["+Inf"] = h[len(bounds)]
+            out["histograms"][self._series(name, labels)] = {
+                "buckets": buckets, "sum": h[-2], "count": h[-1]}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Text exposition format v0.0.4."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: list(v) for k, v in self._hists.items()}
+        lines = []
+        for name in sorted(KNOWN_METRICS):
+            spec = KNOWN_METRICS[name]
+            kind = spec["kind"]
+            store = {"counter": counters, "gauge": gauges,
+                     "histogram": hists}[kind]
+            series = sorted(k for k in store if k[0] == name)
+            if not series:
+                continue
+            lines.append(f"# HELP {name} {spec['help']}")
+            lines.append(f"# TYPE {name} {kind}")
+            if kind != "histogram":
+                for key in series:
+                    lines.append(
+                        f"{self._series(name, key[1])} {_fmt(store[key])}")
+                continue
+            bounds = spec["buckets"]
+            label_names = spec["labels"]
+            for key in series:
+                h = store[key]
+                extra = list(zip(label_names, key[1]))
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += h[i]
+                    lines.append(
+                        f"{_labeled(name + '_bucket', extra, ('le', _fmt(b)))}"
+                        f" {cum}")
+                cum += h[len(bounds)]
+                lines.append(
+                    f"{_labeled(name + '_bucket', extra, ('le', '+Inf'))}"
+                    f" {cum}")
+                base = self._series(name, key[1])
+                suffix = base[len(name):]  # "{...}" or ""
+                lines.append(f"{name}_sum{suffix} {_fmt(h[-2])}")
+                lines.append(f"{name}_count{suffix} {h[-1]}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v) -> str:
+    """Prometheus-friendly number: integral floats print without the
+    trailing ``.0`` (``le="256"`` not ``le="256.0"``)."""
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _labeled(name: str, pairs: list, *extra: tuple) -> str:
+    inner = ",".join(f'{k}="{v}"' for k, v in list(pairs) + list(extra))
+    return f"{name}{{{inner}}}"
+
+
+# -- module-level hooks (the instrumentation surface) ---------------------
+#
+# Exactly the fault_injection._PLAN shape: one global, checked inline.
+# When telemetry is off, _REG is None and every hook is load+test+return.
+
+_REG: Optional[Registry] = None
+
+
+def enabled() -> bool:
+    return _REG is not None
+
+
+def inc_counter(name: str, value: float = 1.0, labels: tuple = ()) -> None:
+    reg = _REG
+    if reg is None:
+        return
+    reg.inc_counter(name, value, labels)
+
+
+def set_gauge(name: str, value: float, labels: tuple = ()) -> None:
+    reg = _REG
+    if reg is None:
+        return
+    reg.set_gauge(name, value, labels)
+
+
+def observe(name: str, value: float, labels: tuple = ()) -> None:
+    reg = _REG
+    if reg is None:
+        return
+    reg.observe(name, value, labels)
+
+
+def configure(on: bool = True) -> None:
+    """Turn the registry on/off.  Turning on when already on keeps the
+    existing series (an elastic re-form re-initializes the engine in the
+    same process; counters must survive it)."""
+    global _REG
+    if on:
+        if _REG is None:
+            _REG = Registry()
+    else:
+        _REG = None
+
+
+def get() -> Optional[Registry]:
+    return _REG
+
+
+def snapshot() -> dict:
+    reg = _REG
+    return reg.snapshot() if reg is not None else {}
+
+
+def render_prometheus() -> str:
+    reg = _REG
+    return reg.render_prometheus() if reg is not None else ""
+
+
+def known_metrics() -> Dict[str, dict]:
+    """Registry accessor for tools/check_metric_docs.py."""
+    return dict(KNOWN_METRICS)

@@ -63,6 +63,17 @@ NONFINITE_POLICY = "HVD_NONFINITE_POLICY"
 NONFINITE_LIMIT = "HVD_NONFINITE_LIMIT"
 AUDIT_INTERVAL = "HVD_AUDIT_INTERVAL"
 CKPT_KEEP = "HVD_CKPT_KEEP"
+# Telemetry (horovod_tpu.telemetry; docs/metrics.md).  METRICS turns the
+# registry on by itself; setting a PORT or FILE also enables it.  PORT is
+# the per-worker debug server base port (bound at PORT + local_rank);
+# FILE is the JSONL flush destination, written every INTERVAL seconds;
+# STRAGGLER_WARN_MS is the consistent-last-rank skew threshold that
+# triggers the STRAGGLER timeline record + warning.
+METRICS = "HVD_METRICS"
+METRICS_PORT = "HVD_METRICS_PORT"
+METRICS_FILE = "HVD_METRICS_FILE"
+METRICS_INTERVAL = "HVD_METRICS_INTERVAL"
+STRAGGLER_WARN_MS = "HVD_STRAGGLER_WARN_MS"
 
 
 def get_bool(name: str, default: bool = False) -> bool:

@@ -42,6 +42,14 @@ NONFINITE_SKIP = "NONFINITE_SKIP"
 DIVERGENCE_DETECTED = "DIVERGENCE_DETECTED"
 CKPT_VERIFY_FAIL = "CKPT_VERIFY_FAIL"
 
+# Telemetry records (horovod_tpu.telemetry; docs/metrics.md).
+STRAGGLER = "STRAGGLER"
+
+# Writer-thread flush cadence: events are buffered and flushed when the
+# queue runs dry or every _FLUSH_EVERY events, whichever comes first —
+# one syscall per burst instead of one per event.
+_FLUSH_EVERY = 64
+
 # Live timelines by path: an elastic reset tears the engine down and
 # re-initializes it in the SAME process, and the new engine must append
 # to the trace instead of truncating it — the reset/re-form cycle being
@@ -96,6 +104,11 @@ class Timeline:
         self._q.put(None)
         self._writer.join(timeout=5)
         try:
+            # Close the JSON array: every event line ends with ",\n", so
+            # a bare "{}]" sentinel object makes the whole trace valid
+            # JSON (chrome://tracing tolerates the unclosed form; plain
+            # json.load does not).
+            self._f.write("{}]\n")
             self._f.close()
         except Exception:
             pass
@@ -170,12 +183,31 @@ class Timeline:
     # -- writer thread ----------------------------------------------------
 
     def _drain(self) -> None:
+        # Batch flushes: a training step can emit hundreds of events in a
+        # burst, and flushing per event turns the writer thread into a
+        # syscall loop.  Write eagerly, flush when the queue runs dry (so
+        # a reader of the file never lags a quiet trace) or every
+        # _FLUSH_EVERY events during a burst.
+        unflushed = 0
         while True:
-            ev = self._q.get()
+            if unflushed:
+                try:
+                    ev = self._q.get_nowait()
+                except queue.Empty:
+                    self._f.flush()
+                    unflushed = 0
+                    ev = self._q.get()
+            else:
+                ev = self._q.get()
             if ev is None:
+                if unflushed:
+                    self._f.flush()
                 break
             self._f.write(json.dumps(ev) + ",\n")
-            self._f.flush()
+            unflushed += 1
+            if unflushed >= _FLUSH_EVERY:
+                self._f.flush()
+                unflushed = 0
 
 
 def engine_event(name: str, **args) -> None:

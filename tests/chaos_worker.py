@@ -75,9 +75,52 @@ def scenario_train_steps(hvd, fi):
         os._exit(17)
 
 
+def scenario_metrics_scrape(hvd, fi):
+    """Telemetry end-to-end (tests/test_telemetry.py): run a few
+    allreduces, then scrape this worker's own /metrics endpoint
+    (HVD_METRICS_PORT + local_rank) and assert the Prometheus text
+    carries the collective counters/histograms and cycle timings."""
+    import re
+    import urllib.request
+
+    for i in range(4):
+        out = hvd.allreduce(np.ones(256, np.float32), op=hvd.Sum,
+                            name=f"mx.step{i}")
+        assert float(out[0]) == hvd.size(), out
+    port = int(os.environ["HVD_METRICS_PORT"]) + hvd.local_rank()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    m = re.search(r'hvd_collectives_total\{op="allreduce",'
+                  r'dtype="float32"\} (\d+)', body)
+    assert m and int(m.group(1)) >= 4, body
+    assert 'hvd_collective_bytes_bucket{op="allreduce"' in body, body
+    assert "hvd_cycles_total" in body, body           # every rank loops
+    assert "hvd_cycle_duration_seconds_count" in body, body
+    snap = hvd.metrics_snapshot()
+    key = 'hvd_collectives_total{op="allreduce",dtype="float32"}'
+    assert snap["counters"][key] >= 4, snap["counters"]
+    print(f"SCRAPE_OK {hvd.rank()}", flush=True)
+
+
+def scenario_straggler(hvd, fi):
+    """Straggler detection end-to-end: the driving test delays rank 1's
+    control sends, so the coordinator sees rank 1 consistently last.
+    Rank 0 dumps its snapshot for driver-side assertions (skew histogram
+    + STRAGGLER events naming rank 1)."""
+    for i in range(12):
+        out = hvd.allreduce(np.ones(8, np.float32), op=hvd.Sum,
+                            name=f"st.step{i}")
+        assert float(out[0]) == hvd.size(), out
+    if hvd.rank() == 0:
+        print("SNAP " + json.dumps(hvd.metrics_snapshot()), flush=True)
+    print(f"STRAGGLER_DONE {hvd.rank()}", flush=True)
+
+
 SCENARIOS = {
     "bootstrap_allreduce": scenario_bootstrap_allreduce,
     "train_steps": scenario_train_steps,
+    "metrics_scrape": scenario_metrics_scrape,
+    "straggler": scenario_straggler,
 }
 
 

@@ -212,6 +212,36 @@ def test_kv_client_retries_through_server_503s(monkeypatch):
         server.stop()
 
 
+def test_metrics_server_sheds_503_under_chaos():
+    """The metrics debug server's ``metrics.server.request`` site sheds
+    requests with 503 (the outage a scraper must ride out), then serves
+    normally once the injected fault budget is spent."""
+    import urllib.request
+
+    from horovod_tpu.telemetry import registry as tmx
+    from horovod_tpu.telemetry.server import MetricsServer
+
+    tmx.configure(True)
+    srv = MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        tmx.inc_counter("hvd_cycles_total")
+        fi.configure({"faults": [
+            {"site": "metrics.server.request", "kind": "error",
+             "times": 2}]})
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5)
+            assert ei.value.code == 503
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "hvd_cycles_total 1" in body  # shed over: scrape lands
+    finally:
+        srv.stop()
+        tmx.configure(False)
+
+
 # ---------------------------------------------------------------------------
 # liveness bookkeeping (in-process)
 # ---------------------------------------------------------------------------

@@ -96,6 +96,30 @@ def test_cli_unset_flags_do_not_override():
     assert env == {}
 
 
+def test_cli_metrics_port_env_mapping():
+    args = make_parser().parse_args([
+        "-np", "2", "--metrics-port", "9090", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env["HVD_METRICS_PORT"] == "9090"
+
+
+def test_cli_metrics_port_validated_at_parse_time(capsys):
+    # Out-of-range ports are an actionable exit-2 before any worker
+    # spawns (each worker binds metrics-port + local_rank, so a bad base
+    # port would otherwise fail rank-by-rank at runtime).
+    from horovod_tpu.runner import run as run_mod
+
+    for bad in ("0", "70000", "-1"):
+        rc = run_mod.run_commandline(
+            ["-np", "1", "--metrics-port", bad, "python", "-c", "pass"])
+        assert rc == 2, bad
+        err = capsys.readouterr().err
+        assert "--metrics-port" in err and "1..65535" in err, err
+    with pytest.raises(SystemExit):  # argparse rejects non-integers
+        run_mod.run_commandline(
+            ["-np", "1", "--metrics-port", "abc", "python", "-c", "pass"])
+
+
 def test_config_file(tmp_path):
     p = tmp_path / "cfg.yaml"
     p.write_text("fusion-threshold-mb: 16\ncycle-time-ms: 2\n")

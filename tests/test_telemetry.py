@@ -1,0 +1,688 @@
+"""Unified telemetry: registry semantics, the zero-cost-when-off pin,
+per-collective instrumentation, straggler detection, the JSONL flusher,
+the Prometheus scrape server, the stall inspector, and the timeline
+writer's batched-flush/footer contract (docs/metrics.md).
+
+Gang scenarios reuse the chaos harness fixture (test_chaos.run_chaos):
+a 2-rank gang scraped over HTTP mid-training, and a chaos-delayed rank
+showing up as a STRAGGLER record plus a skew histogram naming it.
+"""
+
+import gc
+import json
+import logging
+import re
+import socket
+import time
+import tracemalloc
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import telemetry
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.telemetry import registry as tmx
+from horovod_tpu.telemetry.flush import Flusher, kv_from_env
+from horovod_tpu.telemetry.server import MetricsServer, maybe_start
+from horovod_tpu.telemetry.straggler import StragglerDetector
+from horovod_tpu.utils import timeline as timeline_mod
+
+from test_chaos import run_chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """The registry and fault plan are process-global; never leak either
+    across tests (same discipline as test_chaos._no_leaked_plan)."""
+    telemetry.reset()
+    fi.clear()
+    yield
+    telemetry.reset()
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_basics():
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total")
+    tmx.inc_counter("hvd_cycles_total", 2)
+    tmx.set_gauge("hvd_queue_depth", 7)
+    tmx.set_gauge("hvd_queue_depth", 3)  # gauges overwrite
+    tmx.observe("hvd_cycle_duration_seconds", 0.001)
+    tmx.observe("hvd_cycle_duration_seconds", 0.004)
+    snap = tmx.snapshot()
+    assert snap["counters"]["hvd_cycles_total"] == 3
+    assert snap["gauges"]["hvd_queue_depth"] == 3.0
+    h = snap["histograms"]["hvd_cycle_duration_seconds"]
+    assert h["count"] == 2
+    assert h["sum"] == pytest.approx(0.005)
+    assert sum(h["buckets"].values()) == 2
+
+
+def test_labeled_series_keys():
+    tmx.configure(True)
+    tmx.inc_counter("hvd_collectives_total",
+                    labels=("allreduce", "float32"))
+    tmx.observe("hvd_collective_bytes", 1024.0,
+                labels=("allreduce", "float32"))
+    snap = tmx.snapshot()
+    key = 'hvd_collectives_total{op="allreduce",dtype="float32"}'
+    assert snap["counters"][key] == 1
+    hkey = 'hvd_collective_bytes{op="allreduce",dtype="float32"}'
+    assert snap["histograms"][hkey]["count"] == 1
+
+
+def test_histogram_value_on_bound_lands_in_that_bucket():
+    # Prometheus buckets are `le` (inclusive upper bounds): an
+    # observation equal to a bound belongs to that bucket.
+    tmx.configure(True)
+    tmx.observe("hvd_fused_bytes", 256.0)      # == first bound
+    tmx.observe("hvd_fused_bytes", 257.0)      # > first bound
+    tmx.observe("hvd_fused_bytes", 1e12)       # beyond every bound
+    h = tmx.snapshot()["histograms"]["hvd_fused_bytes"]
+    assert h["buckets"]["256"] == 1
+    assert h["buckets"]["512"] == 1
+    assert h["buckets"]["+Inf"] == 1
+    assert h["count"] == 3
+
+
+def test_undeclared_metric_raises():
+    r = tmx.Registry()
+    with pytest.raises(KeyError, match="KNOWN_METRICS"):
+        r.inc_counter("hvd_not_a_metric_total")
+
+
+def test_wrong_kind_raises():
+    r = tmx.Registry()
+    with pytest.raises(TypeError, match="is a counter"):
+        r.observe("hvd_cycles_total", 1.0)
+    with pytest.raises(TypeError, match="is a gauge"):
+        r.inc_counter("hvd_queue_depth")
+
+
+def test_snapshot_and_render_empty_when_off():
+    assert not tmx.enabled()
+    assert tmx.snapshot() == {}
+    assert tmx.render_prometheus() == ""
+
+
+def test_configure_on_keeps_series_off_drops_them():
+    # An elastic re-form re-enters configure(True) in the same process;
+    # counters must span it (docs/metrics.md "survive elastic resets").
+    tmx.configure(True)
+    tmx.inc_counter("hvd_elastic_reforms_total")
+    tmx.configure(True)
+    assert tmx.snapshot()["counters"]["hvd_elastic_reforms_total"] == 1
+    tmx.configure(False)
+    assert tmx.snapshot() == {}
+
+
+def test_render_prometheus_format():
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total", 3)
+    tmx.set_gauge("hvd_elastic_epoch", 2)
+    labels = ("allreduce", "float32")
+    tmx.observe("hvd_collective_bytes", 256.0, labels=labels)
+    tmx.observe("hvd_collective_bytes", 1e12, labels=labels)
+    text = tmx.render_prometheus()
+    assert "# HELP hvd_cycles_total" in text
+    assert "# TYPE hvd_cycles_total counter\nhvd_cycles_total 3\n" in text
+    assert "# TYPE hvd_elastic_epoch gauge\nhvd_elastic_epoch 2" in text
+    assert "# TYPE hvd_collective_bytes histogram" in text
+    # Cumulative buckets: 1 at le="256" .. then +Inf picks up the huge
+    # observation.  Integral bounds print without a trailing ".0".
+    assert ('hvd_collective_bytes_bucket{op="allreduce",dtype="float32",'
+            'le="256"} 1') in text
+    assert ('hvd_collective_bytes_bucket{op="allreduce",dtype="float32",'
+            'le="512"} 1') in text
+    assert ('hvd_collective_bytes_bucket{op="allreduce",dtype="float32",'
+            'le="+Inf"} 2') in text
+    assert ('hvd_collective_bytes_count{op="allreduce",dtype="float32"}'
+            ' 2') in text
+    assert 'le="256.0"' not in text
+    # Metrics with no series are omitted entirely.
+    assert "hvd_stall_warnings_total" not in text
+
+
+def test_log2_buckets():
+    assert tmx.log2_buckets(256.0, 4) == (256.0, 512.0, 1024.0, 2048.0)
+
+
+# ---------------------------------------------------------------------------
+# the zero-cost pin (mirrors test_chaos.test_fire_is_free_when_disabled)
+# ---------------------------------------------------------------------------
+
+
+def test_hooks_are_free_when_disabled():
+    """With telemetry off, every hook must be a single global load +
+    None check: no allocation, pinned via tracemalloc — the hooks live
+    in the engine's hot loop and the eager collective path."""
+    assert not tmx.enabled()
+    tmx.inc_counter("hvd_cycles_total")  # warmup
+    tmx.observe("hvd_cycle_duration_seconds", 0.001)
+    tmx.set_gauge("hvd_queue_depth", 0)
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.get_traced_memory()[0]
+    for _ in range(10000):
+        tmx.inc_counter("hvd_cycles_total")
+        tmx.observe("hvd_cycle_duration_seconds", 0.001)
+        tmx.set_gauge("hvd_queue_depth", 0)
+    after = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+    assert after - before < 512, (before, after)
+
+
+def test_timed_post_is_identity_when_disabled():
+    # The allocating parts of the per-collective instrumentation (label
+    # tuple, timing closure) must not exist when telemetry is off.
+    from horovod_tpu.ops import eager
+
+    assert not tmx.enabled()
+    post = lambda raw: raw  # noqa: E731
+    assert eager._timed_post("allreduce",
+                             np.ones(4, np.float32), post) is post
+    assert eager._timed_post("allreduce",
+                             np.ones(4, np.float32), None) is None
+
+
+def test_timed_post_records_when_enabled():
+    from horovod_tpu.ops import eager
+
+    tmx.configure(True)
+    arr = np.ones(8, np.float32)  # 32 bytes
+    timed = eager._timed_post("allreduce", arr, None)
+    assert timed is not None
+    assert timed("raw") == "raw"  # post=None passes the payload through
+    snap = tmx.snapshot()
+    key = 'hvd_collectives_total{op="allreduce",dtype="float32"}'
+    assert snap["counters"][key] == 1
+    hb = snap["histograms"][
+        'hvd_collective_bytes{op="allreduce",dtype="float32"}']
+    assert hb["count"] == 1 and hb["sum"] == 32
+    hl = snap["histograms"][
+        'hvd_collective_latency_seconds{op="allreduce",dtype="float32"}']
+    assert hl["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+def _complete(det, key, ticks):
+    for rank, t in ticks.items():
+        det.note_ready(key, rank, now=t)
+    return det.note_complete(key)
+
+
+def test_straggler_histogram_only_when_warn_disabled():
+    tmx.configure(True)
+    det = StragglerDetector(warn_ms=0.0, size=2)
+    for i in range(5):
+        assert _complete(det, f"t{i}", {0: 0.0, 1: 0.5}) is None
+    h = tmx.snapshot()["histograms"][
+        'hvd_straggler_skew_seconds{rank="1"}']
+    assert h["count"] == 5
+    assert "hvd_straggler_events_total" not in str(
+        tmx.snapshot()["counters"])
+
+
+def test_straggler_streak_fires_and_rearms():
+    tmx.configure(True)
+    det = StragglerDetector(warn_ms=10.0, size=2)
+    assert _complete(det, "a", {0: 0.0, 1: 0.05}) is None  # streak 1
+    assert _complete(det, "b", {0: 0.0, 1: 0.05}) is None  # streak 2
+    rank, skew = _complete(det, "c", {0: 0.0, 1: 0.05})    # fires
+    assert rank == 1 and skew == pytest.approx(0.05)
+    counters = tmx.snapshot()["counters"]
+    assert counters['hvd_straggler_events_total{rank="1"}'] == 1
+    # Re-armed: the next record needs a full fresh streak.
+    assert _complete(det, "d", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "e", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "f", {0: 0.0, 1: 0.05}) is not None
+
+
+def test_straggler_rank_change_resets_streak():
+    det = StragglerDetector(warn_ms=10.0, size=3)
+    assert _complete(det, "a", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "b", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "c", {0: 0.05, 1: 0.0}) is None  # rank 0 last
+    assert _complete(det, "d", {0: 0.0, 1: 0.05}) is None  # streak 1
+    assert _complete(det, "e", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "f", {0: 0.0, 1: 0.05}) is not None
+
+
+def test_straggler_under_threshold_resets_streak():
+    det = StragglerDetector(warn_ms=10.0, size=2)
+    assert _complete(det, "a", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "b", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "c", {0: 0.0, 1: 0.001}) is None  # fast step
+    assert _complete(det, "d", {0: 0.0, 1: 0.05}) is None   # streak 1
+    assert _complete(det, "e", {0: 0.0, 1: 0.05}) is None
+    assert _complete(det, "f", {0: 0.0, 1: 0.05}) is not None
+
+
+def test_straggler_single_rank_and_first_tick_wins():
+    det = StragglerDetector(warn_ms=10.0, size=2)
+    det.note_ready("t", 0, now=0.0)
+    assert det.note_complete("t") is None  # < 2 ranks: no skew
+    det.note_ready("u", 0, now=0.0)
+    det.note_ready("u", 1, now=0.2)
+    det.note_ready("u", 1, now=9.9)  # re-send must not move the tick
+    det.note_ready("u", 0, now=9.9)
+    tmx.configure(True)
+    assert det.note_complete("u") is None  # streak 1 only
+    h = tmx.snapshot()["histograms"][
+        'hvd_straggler_skew_seconds{rank="1"}']
+    assert h["sum"] == pytest.approx(0.2)
+
+
+def test_straggler_forget_drops_pending():
+    det = StragglerDetector(warn_ms=10.0, size=2)
+    det.note_ready("t", 0, now=0.0)
+    det.note_ready("t", 1, now=5.0)
+    det.forget("t")
+    assert det.note_complete("t") is None
+
+
+# ---------------------------------------------------------------------------
+# stall inspector (coordinator-side, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _stall_engine(size=4, warn_s=1.0, shutdown_s=0.0, joined=()):
+    from horovod_tpu.runtime_py import PyEngine, _MessageTable
+
+    eng = object.__new__(PyEngine)
+    eng.size = size
+    eng.stall_warn_s = warn_s
+    eng.stall_shutdown_s = shutdown_s
+    eng._last_stall_check = 0.0
+    eng._joined_ranks = set(joined)
+    eng._msg_table = _MessageTable(size)
+    eng.log = logging.getLogger("test.stall")
+    return eng
+
+
+def _stall_tensor(eng, name, ranks, waited_s):
+    eng._msg_table.entries[name] = [
+        types.SimpleNamespace(request_rank=r) for r in ranks]
+    eng._msg_table.first_seen[name] = time.monotonic() - waited_s
+
+
+def test_check_stalls_warns_and_names_missing_ranks(caplog):
+    eng = _stall_engine(size=4, warn_s=1.0)
+    _stall_tensor(eng, "grad.w", ranks=[0, 2], waited_s=5.0)
+    tmx.configure(True)
+    with caplog.at_level(logging.WARNING, logger="test.stall"):
+        assert eng._check_stalls() is False  # warn, not shutdown
+    [rec] = caplog.records
+    assert "grad.w" in rec.getMessage()
+    assert "[0, 2]" in rec.getMessage()   # ready ranks
+    assert "[1, 3]" in rec.getMessage()   # missing ranks
+    assert tmx.snapshot()["counters"]["hvd_stall_warnings_total"] == 1
+
+
+def test_check_stalls_excludes_joined_ranks(caplog):
+    eng = _stall_engine(size=4, warn_s=1.0, joined=[3])
+    _stall_tensor(eng, "grad.w", ranks=[0, 2], waited_s=5.0)
+    with caplog.at_level(logging.WARNING, logger="test.stall"):
+        eng._check_stalls()
+    [rec] = caplog.records
+    assert "[1]" in rec.getMessage()  # rank 3 joined: not "missing"
+
+
+def test_check_stalls_shutdown_threshold(caplog):
+    eng = _stall_engine(size=2, warn_s=0.5, shutdown_s=2.0)
+    _stall_tensor(eng, "grad.w", ranks=[0], waited_s=5.0)
+    with caplog.at_level(logging.WARNING, logger="test.stall"):
+        assert eng._check_stalls() is True
+    assert any("shutdown" in r.getMessage() for r in caplog.records)
+
+
+def test_check_stalls_is_paced(caplog):
+    eng = _stall_engine(size=2, warn_s=1.0)
+    _stall_tensor(eng, "grad.w", ranks=[0], waited_s=5.0)
+    eng._last_stall_check = time.monotonic()  # just checked
+    with caplog.at_level(logging.WARNING, logger="test.stall"):
+        assert eng._check_stalls() is False
+    assert not caplog.records  # paced out: no scan, no warning
+
+
+def test_check_stalls_quiet_below_threshold(caplog):
+    eng = _stall_engine(size=2, warn_s=60.0)
+    eng._last_stall_check = time.monotonic() - 31.0  # past the pacing
+    _stall_tensor(eng, "grad.w", ranks=[0], waited_s=1.0)
+    with caplog.at_level(logging.WARNING, logger="test.stall"):
+        assert eng._check_stalls() is False
+    assert not caplog.records
+
+
+# ---------------------------------------------------------------------------
+# timeline writer: batched flushes + the json.load-able footer
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_shutdown_closes_json(tmp_path):
+    path = str(tmp_path / "trace.json")
+    t = timeline_mod.Timeline()
+    t.initialize(path)
+    t.negotiate_start("x", "ALLREDUCE")
+    t.negotiate_rank_ready("x", 1)
+    t.negotiate_end("x")
+    t.instant(timeline_mod.STRAGGLER, rank=1, skew_ms=42.0, tensor="x")
+    t.shutdown()
+    with open(path) as f:
+        events = json.load(f)  # plain parse: the footer closes the array
+    assert events[-1] == {}
+    names = [ev.get("name") for ev in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    straggler = [ev for ev in events if ev.get("name") == "STRAGGLER"]
+    assert straggler and straggler[0]["args"]["rank"] == 1
+
+
+def test_timeline_burst_lands_every_event(tmp_path):
+    # 200 events crosses the _FLUSH_EVERY batching boundary three times;
+    # every event must still land, in order.
+    path = str(tmp_path / "trace.json")
+    t = timeline_mod.Timeline()
+    t.initialize(path)
+    n = timeline_mod._FLUSH_EVERY * 3 + 8
+    for i in range(n):
+        t.instant("MARK", i=i)
+    t.shutdown()
+    with open(path) as f:
+        events = json.load(f)
+    marks = [ev["args"]["i"] for ev in events if ev.get("name") == "MARK"]
+    assert marks == list(range(n))
+
+
+def test_timeline_persistent_shutdown_keeps_writing(tmp_path):
+    # Elastic traces span engine resets: shutdown() must neither close
+    # the file nor write the footer while _persistent is set.
+    path = str(tmp_path / "trace.json")
+    t = timeline_mod.Timeline()
+    t.initialize(path, persistent=True)
+    t.instant("EPOCH_1")
+    t.shutdown()
+    assert t.enabled  # still live for the re-formed engine
+    t.instant("EPOCH_2")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        content = open(path).read()
+        if "EPOCH_2" in content:
+            break
+        time.sleep(0.01)
+    assert "EPOCH_1" in content and "EPOCH_2" in content
+    assert not content.rstrip().endswith("]")  # open-ended until exit
+    t._persistent = False
+    t.shutdown()
+    with open(path) as f:
+        events = json.load(f)  # the final shutdown closes the array
+    assert [ev.get("name") for ev in events[:-1]].count("EPOCH_2") == 1
+
+
+# ---------------------------------------------------------------------------
+# JSONL flusher + rendezvous KV publication
+# ---------------------------------------------------------------------------
+
+
+class _FakeKV:
+    def __init__(self, fail=False):
+        self.puts = []
+        self.fail = fail
+
+    def put(self, key, value):
+        if self.fail:
+            raise ConnectionError("kv down")
+        self.puts.append((key, value))
+
+
+def test_flusher_jsonl_roundtrip(tmp_path):
+    tmx.configure(True)
+    path = str(tmp_path / "metrics.jsonl")
+    fl = Flusher(rank=3, path=path, interval_s=60.0)
+    tmx.inc_counter("hvd_cycles_total")
+    rec = fl.flush_once()
+    assert rec["rank"] == 3 and rec["seq"] == 0
+    tmx.inc_counter("hvd_cycles_total")
+    fl.flush_once()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2
+    parsed = [json.loads(ln) for ln in lines]  # each line round-trips
+    assert [p["seq"] for p in parsed] == [0, 1]
+    assert parsed[0]["counters"]["hvd_cycles_total"] == 1
+    assert parsed[1]["counters"]["hvd_cycles_total"] == 2
+
+
+def test_flusher_skips_empty_snapshot(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    fl = Flusher(rank=0, path=str(path), interval_s=60.0)
+    assert fl.flush_once() is None  # registry off: nothing to say
+    assert not path.exists()
+
+
+def test_flusher_publishes_to_kv():
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total")
+    kv = _FakeKV()
+    Flusher(rank=2, kv=kv, interval_s=60.0).flush_once()
+    [(key, value)] = kv.puts
+    assert key == "metrics/2"
+    assert json.loads(value)["counters"]["hvd_cycles_total"] == 1
+
+
+def test_flusher_kv_failure_warns_once_and_file_survives(tmp_path, caplog):
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total")
+    path = str(tmp_path / "metrics.jsonl")
+    fl = Flusher(rank=0, path=path, kv=_FakeKV(fail=True), interval_s=60.0)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu.telemetry"):
+        fl.flush_once()
+        fl.flush_once()
+    warns = [r for r in caplog.records if "flush" in r.getMessage()]
+    assert len(warns) == 1  # once per kind, not per flush
+    assert len(open(path).read().splitlines()) == 2  # file path unharmed
+
+
+def test_flusher_stop_does_final_flush(tmp_path):
+    tmx.configure(True)
+    path = str(tmp_path / "metrics.jsonl")
+    fl = Flusher(rank=0, path=path, interval_s=60.0)
+    fl.start()
+    tmx.inc_counter("hvd_cycles_total")
+    fl.stop()  # interval never elapsed; stop() must still flush
+    lines = open(path).read().splitlines()
+    assert lines and json.loads(lines[-1])["counters"][
+        "hvd_cycles_total"] == 1
+
+
+def test_kv_from_env_outside_a_job(monkeypatch):
+    monkeypatch.delenv("HVD_RENDEZVOUS_ADDR", raising=False)
+    monkeypatch.delenv("HVD_RENDEZVOUS_PORT", raising=False)
+    assert kv_from_env() is None
+
+
+# ---------------------------------------------------------------------------
+# scrape server
+# ---------------------------------------------------------------------------
+
+
+def _get(port, path, timeout=5):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout)
+
+
+def test_metrics_server_endpoints():
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total")
+    tmx.observe("hvd_cycle_duration_seconds", 0.002)
+    srv = MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        assert _get(port, "/health").read() == b"ok"
+        resp = _get(port, "/metrics")
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+        assert "hvd_cycles_total 1" in text
+        assert "hvd_cycle_duration_seconds_count 1" in text
+        snap = json.load(_get(port, "/metrics.json"))
+        assert snap["counters"]["hvd_cycles_total"] == 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_maybe_start_survives_taken_port(caplog):
+    blocker = socket.socket()
+    blocker.bind(("0.0.0.0", 0))
+    taken = blocker.getsockname()[1]
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="horovod_tpu.telemetry"):
+            assert maybe_start(taken, 0) is None  # warn, don't raise
+        assert any("could not bind" in r.getMessage()
+                   for r in caplog.records)
+    finally:
+        blocker.close()
+
+
+# ---------------------------------------------------------------------------
+# env-driven lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_init_from_env_disabled_by_default(monkeypatch):
+    for var in ("HVD_METRICS", "HVD_METRICS_PORT", "HVD_METRICS_FILE"):
+        monkeypatch.delenv(var, raising=False)
+    assert not telemetry.enabled_in_env()
+    assert telemetry.init_from_env(0) is False
+    assert not tmx.enabled()
+
+
+def test_init_from_env_registry_only(monkeypatch):
+    monkeypatch.setenv("HVD_METRICS", "1")
+    assert telemetry.init_from_env(0) is True
+    assert tmx.enabled()
+    assert telemetry.server_port() is None  # no port knob -> no server
+    tmx.inc_counter("hvd_cycles_total")
+    telemetry.stop()
+    # stop() tears down server/flusher but the registry keeps counting
+    # (elastic re-forms re-init the engine in the same process).
+    assert tmx.snapshot()["counters"]["hvd_cycles_total"] == 1
+
+
+def test_init_from_env_starts_server(monkeypatch):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    monkeypatch.setenv("HVD_METRICS_PORT", str(port))
+    assert telemetry.init_from_env(0, local_rank=0) is True
+    try:
+        assert telemetry.server_port() == port
+        tmx.inc_counter("hvd_cycles_total")
+        assert "hvd_cycles_total 1" in _get(port, "/metrics").read().decode()
+        assert telemetry.init_from_env(0) is True  # idempotent re-entry
+        assert telemetry.server_port() == port
+    finally:
+        telemetry.reset()
+    assert telemetry.server_port() is None
+
+
+def test_init_from_env_starts_flusher(monkeypatch, tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    monkeypatch.setenv("HVD_METRICS_FILE", path)
+    monkeypatch.setenv("HVD_METRICS_INTERVAL", "60")
+    monkeypatch.delenv("HVD_RENDEZVOUS_ADDR", raising=False)
+    assert telemetry.init_from_env(2) is True
+    tmx.inc_counter("hvd_cycles_total")
+    telemetry.stop()  # final flush always lands
+    [line] = open(path).read().splitlines()
+    rec = json.loads(line)
+    assert rec["rank"] == 2
+    assert rec["counters"]["hvd_cycles_total"] == 1
+
+
+def test_metrics_snapshot_facade():
+    import horovod_tpu as hvd
+
+    assert hvd.metrics_snapshot() == {}  # off: empty, never an error
+    tmx.configure(True)
+    tmx.inc_counter("hvd_cycles_total")
+    assert hvd.metrics_snapshot()["counters"]["hvd_cycles_total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# gang scenarios (2-rank, loopback mesh)
+# ---------------------------------------------------------------------------
+
+
+def _free_port_pair():
+    """A base port p with p and p+1 both free (2 workers bind
+    base + local_rank)."""
+    for _ in range(20):
+        s1, s2 = socket.socket(), socket.socket()
+        try:
+            s1.bind(("127.0.0.1", 0))
+            base = s1.getsockname()[1]
+            s2.bind(("127.0.0.1", base + 1))
+            return base
+        except OSError:
+            continue
+        finally:
+            s1.close()
+            s2.close()
+    raise RuntimeError("no free port pair")
+
+
+def test_gang_metrics_scrape():
+    """Both workers of a live 2-rank gang serve GET /metrics on
+    base_port + local_rank; the scenario scrapes its own endpoint
+    mid-run and asserts allreduce counts, byte histograms, and cycle
+    timings are all present (the assertions live in
+    chaos_worker.scenario_metrics_scrape)."""
+    base = _free_port_pair()
+    outs = run_chaos("metrics_scrape", 2,
+                     base_env={"HVD_METRICS_PORT": str(base)})
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+        assert f"SCRAPE_OK {rank}" in out, (rank, out, err)
+
+
+def test_gang_straggler_detected(tmp_path):
+    """Chaos-delay rank 1's control sends: the coordinator's skew
+    histogram names rank 1, hvd_straggler_events_total fires, and a
+    STRAGGLER record lands on the timeline."""
+    tl_path = str(tmp_path / "trace.json")
+    plan = json.dumps({"faults": [
+        {"site": "ctrl.worker.send", "kind": "delay", "delay_s": 0.05}]})
+    outs = run_chaos(
+        "straggler", 2,
+        base_env={"HVD_METRICS": "1", "HVD_STRAGGLER_WARN_MS": "20"},
+        rank_env={0: {"HVD_TIMELINE": tl_path},
+                  1: {"HOROVOD_FAULT_PLAN": plan}})
+    for rank, (code, out, err) in enumerate(outs):
+        assert code == 0, (rank, out, err)
+    m = re.search(r"SNAP (.*)", outs[0][1])
+    assert m, outs[0][1]
+    snap = json.loads(m.group(1))
+    skew = snap["histograms"]['hvd_straggler_skew_seconds{rank="1"}']
+    assert skew["count"] > 0
+    assert snap["counters"]['hvd_straggler_events_total{rank="1"}'] >= 1
+    with open(tl_path) as f:
+        events = json.load(f)  # clean shutdown: footer makes it parse
+    straggler = [ev for ev in events if ev.get("name") == "STRAGGLER"]
+    assert straggler, events[-5:]
+    assert straggler[0]["args"]["rank"] == 1
+    assert straggler[0]["args"]["skew_ms"] > 20.0
