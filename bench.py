@@ -122,6 +122,36 @@ def _step_flops(step, state, images, labels):
         return None
 
 
+def _fused_small_tensor_worker(iters: int, k: int, count: int) -> float:
+    """Runs on every rank of an eager gang: k tiny fp32 tensors per step
+    submitted async and synchronized together — the fusion-bound workload
+    the persistent-sender/fusion-buffer data plane is built for
+    (docs/performance.md).  Returns tensors/sec."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    xs = [np.random.RandomState(rank + i).randn(count).astype(np.float32)
+          for i in range(k)]
+
+    def one():
+        hs = [hvd.allreduce_async(xs[i], op=hvd.Sum, name=f"small.{i}")
+              for i in range(k)]
+        for h in hs:
+            hvd.synchronize(h)
+
+    one()
+    one()  # second warm pass lands on the response cache
+    hvd.barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one()
+    dt = time.perf_counter() - t0
+    return iters * k / dt
+
+
 def main() -> None:
     from horovod_tpu.utils.platform import (
         default_backend_alive,
@@ -394,6 +424,23 @@ def main() -> None:
         extras["decode_tokens_per_sec"] = round(float(np.median(rates)), 1)
     except Exception as e:
         extras["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- eager data plane: fused-small-tensor rate ----------------------
+    # A real 2-rank Python-engine gang over the host TCP mesh (run-func
+    # mode — same launch path as examples/engine_benchmark.py), timing
+    # 64 tiny tensors per step through the persistent-sender /
+    # fusion-buffer path (docs/performance.md).  In-graph metrics above
+    # never touch that plane.
+    try:
+        from horovod_tpu.runner.run import run as hvd_run
+
+        per_rank = hvd_run(
+            _fused_small_tensor_worker, (20, 64, 1024), np=2,
+            env={"HVD_TPU_CORE": "py", "JAX_PLATFORMS": "cpu"})
+        extras["allreduce_fused_small_tensors_per_sec"] = round(
+            per_rank[0], 1)
+    except Exception as e:
+        extras["fused_small_error"] = f"{type(e).__name__}: {e}"[:200]
 
     baseline = 1656.82 / 16.0  # reference's per-device number
     line = {

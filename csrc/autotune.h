@@ -85,6 +85,7 @@ struct TunedParams {
   bool cache_enabled = true;
   bool hierarchical_allreduce = false;
   bool hierarchical_allgather = false;
+  int64_t ring_segment_bytes = 0;
 };
 
 // Rank-0 tuner: feed allreduced bytes, get knob updates to broadcast.

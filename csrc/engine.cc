@@ -282,7 +282,7 @@ Engine::Engine(const EngineConfig& cfg, std::vector<int> data_fds,
     pm_ = std::make_unique<ParameterManager>(
         TunedParams{cfg.fusion_threshold, cfg.cycle_time_s,
                     cfg.cache_capacity > 0, cfg.hierarchical_allreduce,
-                    cfg.hierarchical_allgather},
+                    cfg.hierarchical_allgather, cfg.ring_segment_bytes},
         opts);
   }
   bg_ = std::thread([this] { BackgroundLoop(); });
@@ -658,6 +658,7 @@ void Engine::ApplyParams(const WireParams& p) {
   cfg_.cycle_time_s = p.cycle_time_s;
   cfg_.hierarchical_allreduce = p.hierarchical_allreduce;
   cfg_.hierarchical_allgather = p.hierarchical_allgather;
+  cfg_.ring_segment_bytes = p.ring_segment_bytes;
   std::lock_guard<std::mutex> lk(cache_mu_);
   cache_classify_enabled_ = p.cache_enabled;
 }
@@ -899,6 +900,7 @@ bool Engine::CoordinatorCycle(std::vector<Request> msgs) {
       wp.cache_enabled = pending_params_.cache_enabled;
       wp.hierarchical_allreduce = pending_params_.hierarchical_allreduce;
       wp.hierarchical_allgather = pending_params_.hierarchical_allgather;
+      wp.ring_segment_bytes = pending_params_.ring_segment_bytes;
       have_pending_params_ = false;
     }
     std::vector<uint8_t> shared;

@@ -100,6 +100,9 @@ struct EngineConfig {
   // topology is actually hierarchical (local_size>1 && cross_size>1).
   bool hierarchical_allreduce = false;
   bool hierarchical_allgather = false;
+  // Ring-hop receive segmentation (PyEngine data plane; carried here so
+  // the knob round-trips the params broadcast unchanged in mixed jobs).
+  int64_t ring_segment_bytes = 0;
   // Autotuner (coordinator only; parity: parameter_manager.cc).
   bool autotune = false;
   ParameterManager::Options autotune_opts;

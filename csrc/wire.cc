@@ -223,6 +223,7 @@ std::vector<uint8_t> EncodeResponseList(
     PutU8(b, params.cache_enabled ? 1 : 0);
     PutU8(b, params.hierarchical_allreduce ? 1 : 0);
     PutU8(b, params.hierarchical_allgather ? 1 : 0);
+    PutI64(b, params.ring_segment_bytes);
   }
   PutU32(b, epoch);
   return b;
@@ -251,6 +252,7 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
     params->cache_enabled = rd.U8() != 0;
     params->hierarchical_allreduce = rd.U8() != 0;
     params->hierarchical_allgather = rd.U8() != 0;
+    params->ring_segment_bytes = rd.I64();
   }
   // Optional epoch trailer (0 on frames that predate it).
   uint32_t e = (!rd.fail && rd.off + 4 <= rd.len) ? rd.U32() : 0;

@@ -89,6 +89,7 @@ struct WireParams {
   bool cache_enabled = true;
   bool hierarchical_allreduce = false;
   bool hierarchical_allgather = false;
+  int64_t ring_segment_bytes = 0;
 };
 
 std::vector<uint8_t> EncodeResponseList(

@@ -35,6 +35,11 @@ from horovod_tpu.utils import env as env_util
 _MAX_FUSION = 64 << 20  # tuning range upper bound, parity with reference
 _MIN_CYCLE_S = 0.0005
 _MAX_CYCLE_S = 0.025
+# Ring-hop segment (docs/performance.md): 0 = unsegmented; tuned over
+# 64 KiB steps up to 4 MiB — past that a segment no longer fits typical
+# kernel socket buffers and the recv/reduce overlap disappears.
+_MAX_SEGMENT = 4 << 20
+_SEGMENT_STEP = 64 << 10
 
 
 def autotune_options_from_env(hierarchical_ok: bool = False
@@ -56,6 +61,7 @@ def autotune_options_from_env(hierarchical_ok: bool = False
         tune_hier_allgather=(
             hierarchical_ok
             and env_util.HIERARCHICAL_ALLGATHER not in os.environ),
+        tune_segment=env_util.RING_SEGMENT_BYTES not in os.environ,
         warmup_samples=env_util.get_int(env_util.AUTOTUNE_WARMUP_SAMPLES, 3),
         max_samples=env_util.get_int(env_util.AUTOTUNE_MAX_SAMPLES, 20),
         sample_duration_s=env_util.get_float(
@@ -64,7 +70,7 @@ def autotune_options_from_env(hierarchical_ok: bool = False
     )
     if not any(opts[k] for k in ("tune_fusion", "tune_cycle", "tune_cache",
                                  "tune_hier_allreduce",
-                                 "tune_hier_allgather")):
+                                 "tune_hier_allgather", "tune_segment")):
         return None
     return opts
 
@@ -78,6 +84,7 @@ class TunedParams:
     cache_enabled: bool
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    ring_segment_bytes: int = 0
 
     def __eq__(self, other) -> bool:
         return (self.fusion_threshold == other.fusion_threshold
@@ -86,7 +93,8 @@ class TunedParams:
                 and self.hierarchical_allreduce
                 == other.hierarchical_allreduce
                 and self.hierarchical_allgather
-                == other.hierarchical_allgather)
+                == other.hierarchical_allgather
+                and self.ring_segment_bytes == other.ring_segment_bytes)
 
 
 class ParameterManager:
@@ -97,6 +105,7 @@ class ParameterManager:
                  tune_cache: bool = True,
                  tune_hier_allreduce: bool = False,
                  tune_hier_allgather: bool = False,
+                 tune_segment: bool = False,
                  warmup_samples: int = 3, max_samples: int = 20,
                  sample_duration_s: float = 0.5,
                  log_path: Optional[str] = None):
@@ -114,6 +123,8 @@ class ParameterManager:
             self._dims.append("hier_ar")
         if tune_hier_allgather:
             self._dims.append("hier_ag")
+        if tune_segment:
+            self._dims.append("segment")
         self._bo = BayesianOptimization(dim=max(1, len(self._dims)))
         self._warmup_left = warmup_samples
         self._max_samples = max_samples
@@ -127,13 +138,14 @@ class ParameterManager:
             self._log.write(
                 "sample,score_bytes_per_s,fusion_threshold,"
                 "cycle_time_ms,cache_enabled,hierarchical_allreduce,"
-                "hierarchical_allgather\n")
+                "hierarchical_allgather,ring_segment_bytes\n")
 
     @classmethod
     def from_env(cls, fusion_threshold: int, cycle_time_s: float,
                  hierarchical_allreduce: bool = False,
                  hierarchical_allgather: bool = False,
-                 hierarchical_ok: bool = False
+                 hierarchical_ok: bool = False,
+                 ring_segment_bytes: int = 0
                  ) -> Optional["ParameterManager"]:
         """None unless HVD_AUTOTUNE is on.  Env-pinned knobs are fixed;
         if every knob is pinned there is nothing to tune."""
@@ -142,7 +154,8 @@ class ParameterManager:
             return None
         return cls(TunedParams(fusion_threshold, cycle_time_s, True,
                                hierarchical_allreduce,
-                               hierarchical_allgather), **opts)
+                               hierarchical_allgather,
+                               ring_segment_bytes), **opts)
 
     # -- parameter vector mapping ----------------------------------------
 
@@ -158,6 +171,8 @@ class ParameterManager:
                 x.append(1.0 if p.hierarchical_allreduce else 0.0)
             elif d == "hier_ag":
                 x.append(1.0 if p.hierarchical_allgather else 0.0)
+            elif d == "segment":
+                x.append(p.ring_segment_bytes / _MAX_SEGMENT)
             else:
                 x.append(1.0 if p.cache_enabled else 0.0)
         return np.asarray(x or [0.0], np.float64)
@@ -167,7 +182,8 @@ class ParameterManager:
                         self.current.cycle_time_s,
                         self.current.cache_enabled,
                         self.current.hierarchical_allreduce,
-                        self.current.hierarchical_allgather)
+                        self.current.hierarchical_allgather,
+                        self.current.ring_segment_bytes)
         for i, d in enumerate(self._dims):
             v = float(np.clip(x[i], 0.0, 1.0))
             if d == "fusion":
@@ -181,6 +197,10 @@ class ParameterManager:
                 p.hierarchical_allreduce = v >= 0.5
             elif d == "hier_ag":
                 p.hierarchical_allgather = v >= 0.5
+            elif d == "segment":
+                # snap to 64 KiB steps; the bottom step rounds to 0 = off
+                p.ring_segment_bytes = int(
+                    round(v * _MAX_SEGMENT / _SEGMENT_STEP)) * _SEGMENT_STEP
             else:
                 p.cache_enabled = v >= 0.5
         return p
@@ -226,7 +246,8 @@ class ParameterManager:
                 f"{self.current.cycle_time_s * 1e3:.3f},"
                 f"{int(self.current.cache_enabled)},"
                 f"{int(self.current.hierarchical_allreduce)},"
-                f"{int(self.current.hierarchical_allgather)}\n")
+                f"{int(self.current.hierarchical_allgather)},"
+                f"{self.current.ring_segment_bytes}\n")
             self._log.flush()
 
         if self._samples >= self._max_samples:
@@ -240,7 +261,8 @@ class ParameterManager:
                     f"{self.current.cycle_time_s * 1e3:.3f},"
                     f"{int(self.current.cache_enabled)},"
                     f"{int(self.current.hierarchical_allreduce)},"
-                    f"{int(self.current.hierarchical_allgather)}\n")
+                    f"{int(self.current.hierarchical_allgather)},"
+                    f"{self.current.ring_segment_bytes}\n")
                 self._log.close()
                 self._log = None
             return self.current

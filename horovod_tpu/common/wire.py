@@ -32,7 +32,8 @@ Layout (all integers little-endian):
                   u8 has_params,
                   [ i64 fusion_threshold, f64 cycle_time_s,
                     u8 cache_enabled, u8 hierarchical_allreduce,
-                    u8 hierarchical_allgather ],  # iff has_params
+                    u8 hierarchical_allgather,
+                    i64 ring_segment_bytes ],  # iff has_params
                   [ u32 epoch ]                   # optional trailer
 
 The ``epoch`` trailer is the **membership epoch** of the sender's gang
@@ -253,11 +254,12 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
                          hit_positions: List[int] = (),
                          resend_names: List[str] = (),
                          params: Optional[Tuple[int, float, bool,
-                                                bool, bool]] = None,
+                                                bool, bool, int]] = None,
                          epoch: int = 0) -> bytes:
     """``params``: (fusion_threshold, cycle_time_s, cache_enabled,
-    hierarchical_allreduce, hierarchical_allgather) knob broadcast from
-    the autotuner, or None."""
+    hierarchical_allreduce, hierarchical_allgather, ring_segment_bytes)
+    knob broadcast from the autotuner, or None.  A 5-tuple is accepted
+    for callers predating the segment knob (encoded as 0)."""
     buf = bytearray()
     buf += struct.pack("<BI", 1 if shutdown else 0, len(resps))
     for r in resps:
@@ -271,17 +273,18 @@ def encode_response_list(resps: List[Response], shutdown: bool = False,
     if params is None:
         buf += struct.pack("<B", 0)
     else:
-        fusion, cycle_s, cache_on, hier_ar, hier_ag = params
-        buf += struct.pack("<BqdBBB", 1, fusion, cycle_s,
+        fusion, cycle_s, cache_on, hier_ar, hier_ag = params[:5]
+        segment = params[5] if len(params) > 5 else 0
+        buf += struct.pack("<BqdBBBq", 1, fusion, cycle_s,
                            1 if cache_on else 0, 1 if hier_ar else 0,
-                           1 if hier_ag else 0)
+                           1 if hier_ag else 0, segment)
     buf += struct.pack("<I", epoch)
     return bytes(buf)
 
 
 def decode_response_list(data: bytes) -> Tuple[
         List[Response], bool, List[int], List[str],
-        Optional[Tuple[int, float, bool, bool, bool]], int]:
+        Optional[Tuple[int, float, bool, bool, bool, int]], int]:
     shutdown, n = struct.unpack_from("<BI", data, 0)
     off = struct.calcsize("<BI")
     out = []
@@ -305,11 +308,11 @@ def decode_response_list(data: bytes) -> Tuple[
     off += 1
     params = None
     if has_params:
-        fusion, cycle_s, cache_on, hier_ar, hier_ag = struct.unpack_from(
-            "<qdBBB", data, off)
-        off += struct.calcsize("<qdBBB")
+        fusion, cycle_s, cache_on, hier_ar, hier_ag, segment = \
+            struct.unpack_from("<qdBBBq", data, off)
+        off += struct.calcsize("<qdBBBq")
         params = (fusion, cycle_s, bool(cache_on), bool(hier_ar),
-                  bool(hier_ag))
+                  bool(hier_ag), segment)
     epoch = 0
     if off + 4 <= len(data):  # pre-trailer encoders stop here
         (epoch,) = struct.unpack_from("<I", data, off)

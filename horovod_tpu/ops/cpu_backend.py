@@ -17,18 +17,32 @@ Algorithms:
   ops/adasum.py for the math; eager variant used when Request.reduce_op is
   ADASUM, parity: adasum_mpi_operations.cc).
 
-Each transfer is a framed TCP message; sends run on a helper thread so the
-simultaneous send/recv of ring steps cannot deadlock on kernel buffers.
+Data-plane hot path (docs/performance.md): sends ride one persistent
+:class:`~horovod_tpu.utils.socketutil.PeerSender` thread per peer socket
+(so ring steps overlap send and recv without spawning a thread per hop),
+entries are packed once into the engine's persistent
+:class:`~horovod_tpu.ops.fusion_buffer.FusionBuffer` and the ring
+reduce-scatter/allgather walks slices of it in place (``recv_into`` a
+preallocated hop buffer, ufuncs with ``out=``, no trailing concatenate),
+and each hop's receive is optionally segmented at ``HVD_RING_SEGMENT_BYTES``
+so reducing segment k overlaps the kernel receiving segment k+1
+(DeAR-style, arXiv:2302.12445).  Segmentation is receiver-local — the wire
+still carries one frame per hop, so segmented and unsegmented peers (and
+the native C++ engine) interoperate.  Results are bit-identical to the
+copy-per-hop implementation this replaced: operand order and the fp32
+accumulation path for sub-32-bit floats are preserved exactly.
 """
 
 from __future__ import annotations
 
-import threading
+import time
 from typing import List, Optional
 
 import numpy as np
 
 from horovod_tpu.common.types import DataType, ReduceOp, Response
+from horovod_tpu.ops.fusion_buffer import FusionBuffer
+from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import socketutil as su
 
 
@@ -38,11 +52,33 @@ def _np_dtype(dt: DataType):
     return f(dt)
 
 
-def _send_async(sock, payload: bytes) -> threading.Thread:
-    t = threading.Thread(
-        target=su.send_frame, args=(sock, su.TAG_DATA, payload), daemon=True)
-    t.start()
-    return t
+def _sender(engine, rank: int) -> su.PeerSender:
+    """The persistent sender for peer ``rank`` — created at engine
+    bootstrap; lazily instantiated here for bare test engines."""
+    senders = getattr(engine, "_senders", None)
+    if senders is None:
+        senders = engine._senders = {}
+    s = senders.get(rank)
+    if s is None:
+        s = senders[rank] = su.PeerSender(
+            engine._data[rank], name=f"hvd-send-{rank}")
+    return s
+
+
+def _scratch(engine) -> FusionBuffer:
+    fb = getattr(engine, "_fusion_buf", None)
+    if fb is None:
+        fb = engine._fusion_buf = FusionBuffer()
+    return fb
+
+
+def _segment_elems(engine, itemsize: int) -> int:
+    """Ring-hop receive segment in elements (0 = unsegmented), from the
+    engine's ``ring_segment_bytes`` knob rounded down to whole elements."""
+    seg = int(getattr(engine, "ring_segment_bytes", 0) or 0)
+    if seg <= 0:
+        return 0
+    return max(1, seg // itemsize)
 
 
 def _recv(sock) -> bytes:
@@ -50,6 +86,24 @@ def _recv(sock) -> bytes:
     if tag != su.TAG_DATA:
         raise ConnectionError(f"expected data frame, got tag {tag}")
     return payload
+
+
+def _recv_data_header(sock) -> int:
+    tag, nbytes = su.recv_frame_header(sock)
+    if tag != su.TAG_DATA:
+        raise ConnectionError(f"expected data frame, got tag {tag}")
+    return nbytes
+
+
+def _recv_into(sock, dst: np.ndarray) -> None:
+    """Receive one data frame straight into ``dst`` (contiguous view)."""
+    nbytes = _recv_data_header(sock)
+    if nbytes != dst.nbytes:
+        raise ConnectionError(
+            f"ring hop size mismatch: got {nbytes} bytes, expected "
+            f"{dst.nbytes}")
+    if nbytes:
+        su.recv_exact_into(sock, memoryview(dst.view(np.uint8)))
 
 
 def _needs_f32_math(dtype: np.dtype) -> bool:
@@ -77,6 +131,72 @@ def _combine(a: np.ndarray, b: np.ndarray, op: ReduceOp) -> np.ndarray:
     raise ValueError(f"unsupported reduce op {op}")
 
 
+def _combine_out(a: np.ndarray, b: np.ndarray, out: np.ndarray,
+                 op: ReduceOp) -> None:
+    """``out[...] = combine(a, b)`` without allocating.  Operand order
+    matches :func:`_combine` so results stay bit-identical (NaN payload
+    propagation included)."""
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        np.add(a, b, out=out)
+    elif op == ReduceOp.MIN:
+        np.minimum(a, b, out=out)
+    elif op == ReduceOp.MAX:
+        np.maximum(a, b, out=out)
+    elif op == ReduceOp.PRODUCT:
+        np.multiply(a, b, out=out)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+
+
+def _combine_into(incoming: np.ndarray, mine: np.ndarray, op: ReduceOp,
+                  fb: FusionBuffer) -> None:
+    """In-place hop reduction: ``mine[...] = combine(incoming, mine)``.
+
+    Bit-identical to ``_combine(incoming, mine)``: same operand order,
+    and sub-32-bit floats route through persistent fp32 scratch (upcast
+    → reduce → downcast, the half.cc path) instead of ``astype``
+    temporaries."""
+    if _needs_f32_math(mine.dtype):
+        n = mine.size
+        a32, b32 = fb.f32_views(n)
+        a32[...] = incoming
+        b32[...] = mine
+        _combine_out(a32, b32, b32, op)
+        mine[...] = b32
+        return
+    _combine_out(incoming, mine, mine, op)
+
+
+def _recv_combine(sock, mine: np.ndarray, hop: np.ndarray,
+                  hop_mv: memoryview, op: ReduceOp, seg: int,
+                  fb: FusionBuffer) -> None:
+    """Receive one hop's chunk and reduce it into ``mine`` in place.
+
+    With ``seg`` > 0, the payload is drained in ``seg``-element slices:
+    while numpy reduces slice k, the kernel keeps receiving slice k+1
+    into the socket buffer — the DeAR-style transfer/reduction overlap,
+    with no extra threads and no wire-format change."""
+    nbytes = _recv_data_header(sock)
+    n = mine.size
+    isz = mine.itemsize
+    if nbytes != n * isz:
+        raise ConnectionError(
+            f"ring hop size mismatch: got {nbytes} bytes, expected "
+            f"{n * isz}")
+    if n == 0:
+        return
+    if seg <= 0 or seg >= n:
+        su.recv_exact_into(sock, hop_mv[:nbytes])
+        _combine_into(hop[:n], mine, op, fb)
+        return
+    done = 0
+    while done < n:
+        k = min(seg, n - done)
+        su.recv_exact_into(sock, hop_mv[done * isz:(done + k) * isz])
+        _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
+        done += k
+
+
 def _chunk_bounds(n: int, parts: int) -> List[int]:
     """NCCL-style near-equal split: bounds[i]..bounds[i+1] is chunk i."""
     base, rem = divmod(n, parts)
@@ -88,43 +208,63 @@ def _chunk_bounds(n: int, parts: int) -> List[int]:
 
 def ring_allreduce_flat(engine, flat: np.ndarray,
                         op: ReduceOp) -> np.ndarray:
-    """In-place-style ring allreduce of a flat array; returns the result."""
+    """Ring allreduce of a flat array; the input is left unmodified and
+    the reduced result is returned as a new array."""
     group = list(range(engine.size))
-    return _ring_allreduce_group(engine, flat, op, group, engine.rank)
+    return _ring_allreduce_group(engine, flat.copy(), op, group,
+                                 engine.rank)
 
 
 def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
                           group, me: int) -> np.ndarray:
     """Ring allreduce restricted to ``group`` (global ranks, any order);
     ``me`` is this rank's index within it.  Same chunk walk as the C++
-    engine (RingAllreduceGroup) so mixed jobs stay bit-identical."""
+    engine (RingAllreduceGroup) so mixed jobs stay bit-identical.
+
+    Operates IN PLACE on ``flat`` (and returns it): callers pass scratch
+    — a fusion-buffer view or their own copy.  Each step's send region
+    and recv/reduce region are adjacent-but-disjoint chunks, so the
+    sender thread reads stable memory while this thread reduces."""
     size = len(group)
     if size == 1:
         return flat
-    right = engine._data[group[(me + 1) % size]]
+    right = _sender(engine, group[(me + 1) % size])
     left = engine._data[group[(me - 1) % size]]
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, size)
-    chunks = [flat[bounds[i]:bounds[i + 1]].copy() for i in range(size)]
+    max_chunk = max(bounds[i + 1] - bounds[i] for i in range(size))
+    fb = _scratch(engine)
+    hop = fb.hop_view(max_chunk, dtype)
+    hop_mv = memoryview(hop.view(np.uint8))
+    seg = _segment_elems(engine, dtype.itemsize)
+    timed = _tmx.enabled()
 
     # Phase 1: ring reduce-scatter.
     for step in range(size - 1):
+        t0 = time.perf_counter() if timed else 0.0
         send_idx = (me - step) % size
         recv_idx = (me - step - 1) % size
-        t = _send_async(right, chunks[send_idx].tobytes())
-        incoming = np.frombuffer(_recv(left), dtype=dtype).copy()
-        t.join()
-        chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
+        ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
+        _recv_combine(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
+                      hop, hop_mv, op, seg, fb)
+        right.wait(ticket)
+        if timed:
+            _tmx.observe("hvd_ring_hop_seconds",
+                         time.perf_counter() - t0, ("reduce_scatter",))
 
-    # Phase 2: ring allgather of the reduced chunks.
+    # Phase 2: ring allgather of the reduced chunks, straight into place.
     for step in range(size - 1):
+        t0 = time.perf_counter() if timed else 0.0
         send_idx = (me + 1 - step) % size
         recv_idx = (me - step) % size
-        t = _send_async(right, chunks[send_idx].tobytes())
-        chunks[recv_idx] = np.frombuffer(_recv(left), dtype=dtype).copy()
-        t.join()
+        ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
+        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]])
+        right.wait(ticket)
+        if timed:
+            _tmx.observe("hvd_ring_hop_seconds",
+                         time.perf_counter() - t0, ("allgather",))
 
-    return np.concatenate([np.atleast_1d(c) for c in chunks])
+    return flat
 
 
 def _local_group(engine):
@@ -147,41 +287,47 @@ def hierarchical_allreduce_flat(engine, flat: np.ndarray,
     node-local links; only 1/local_size of the bytes crosses nodes.
     Requires the launcher's homogeneous block rank layout, checked by
     ``engine.hierarchical_topology_ok()`` before dispatching here.
+    In place on ``flat`` like :func:`_ring_allreduce_group`.
     """
     L = engine.local_size
     li = engine.local_rank
     local = _local_group(engine)
-    right = engine._data[local[(li + 1) % L]]
+    right = _sender(engine, local[(li + 1) % L])
     left = engine._data[local[(li - 1) % L]]
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, L)
-    chunks = [flat[bounds[i]:bounds[i + 1]].copy() for i in range(L)]
+    max_chunk = max(bounds[i + 1] - bounds[i] for i in range(L))
+    fb = _scratch(engine)
+    hop = fb.hop_view(max_chunk, dtype)
+    hop_mv = memoryview(hop.view(np.uint8))
+    seg = _segment_elems(engine, dtype.itemsize)
 
     # Phase 1: local ring reduce-scatter.
     for step in range(L - 1):
         send_idx = (li - step) % L
         recv_idx = (li - step - 1) % L
-        t = _send_async(right, chunks[send_idx].tobytes())
-        incoming = np.frombuffer(_recv(left), dtype=dtype).copy()
-        t.join()
-        chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
+        ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
+        _recv_combine(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
+                      hop, hop_mv, op, seg, fb)
+        right.wait(ticket)
 
-    # Phase 2: cross-node ring allreduce of the fully-reduced owned chunk.
+    # Phase 2: cross-node ring allreduce of the fully-reduced owned
+    # chunk, in place on its slice of the fusion buffer.
     own = (li + 1) % L
-    if chunks[own].size:
-        chunks[own] = _ring_allreduce_group(
-            engine, chunks[own], op, _cross_group(engine),
-            engine.cross_rank)
+    own_slice = flat[bounds[own]:bounds[own + 1]]
+    if own_slice.size:
+        _ring_allreduce_group(engine, own_slice, op, _cross_group(engine),
+                              engine.cross_rank)
 
     # Phase 3: local ring allgather.
     for step in range(L - 1):
         send_idx = (li + 1 - step) % L
         recv_idx = (li - step) % L
-        t = _send_async(right, chunks[send_idx].tobytes())
-        chunks[recv_idx] = np.frombuffer(_recv(left), dtype=dtype).copy()
-        t.join()
+        ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
+        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]])
+        right.wait(ticket)
 
-    return np.concatenate([np.atleast_1d(c) for c in chunks])
+    return flat
 
 
 def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
@@ -201,9 +347,10 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
     while k < size:
         partner = rank ^ k
         sock = engine._data[partner]
-        t = _send_async(sock, acc.tobytes())
+        sender = _sender(engine, partner)
+        ticket = sender.send(acc)
         other = np.frombuffer(_recv(sock), dtype=np.float64).copy()
-        t.join()
+        sender.wait(ticket)
         if rank < partner:
             acc = adasum_pair_numpy(acc, other)
         else:
@@ -287,23 +434,32 @@ ALLREDUCE_CHAIN = (AdasumAllreduce(), HierarchicalAllreduce(),
 def allreduce(engine, entries, resp: Response):
     """Fused allreduce over all entries of the response.  The op and the
     scale factors come from the negotiated response (identical on every
-    rank, including joined ranks whose entries are zero stand-ins)."""
+    rank, including joined ranks whose entries are zero stand-ins).
+
+    Entries are packed once into the engine's persistent fusion buffer;
+    the ring then mutates that scratch in place.  ``fused`` tracks
+    whether ``reduced`` still aliases the fusion buffer — if it does,
+    results are carved from a per-collective copy so the next collective
+    cannot clobber them."""
     op = resp.reduce_op
     prescale = resp.prescale_factor
     postscale = resp.postscale_factor
     dtype = _np_dtype(resp.tensor_type)
-    flats = [np.ravel(e.array).astype(dtype, copy=False) for e in entries]
-    flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
+    fb = _scratch(engine)
+    flat = fb.pack(entries, dtype)
+    fused = True
     if prescale != 1.0:
         if _needs_f32_math(dtype):
             flat = (flat.astype(np.float32) * prescale).astype(dtype)
         else:
             flat = flat * dtype.type(prescale)
+        fused = False
 
     group, me = resp_group(engine, resp)
     reduced = next(c for c in ALLREDUCE_CHAIN
                    if c.enabled(engine, resp)).execute(engine, flat, op,
                                                        group, me)
+    fused = fused and reduced is flat
 
     if op == ReduceOp.AVERAGE:
         n = len(group)
@@ -311,16 +467,13 @@ def allreduce(engine, entries, resp: Response):
             reduced = (reduced.astype(np.float32) / n).astype(dtype)
         else:
             reduced = reduced / dtype.type(n)
+        fused = False
     if postscale != 1.0:
         reduced = (reduced * postscale).astype(dtype, copy=False)
-
-    results = []
-    off = 0
-    for e in entries:
-        n = e.array.size
-        results.append(reduced[off:off + n].reshape(e.array.shape))
-        off += n
-    return results
+        fused = False
+    if fused:
+        reduced = reduced.copy()
+    return fb.unpack(reduced, entries)
 
 
 def _allgather_hierarchical(engine, entries, resp: Response):
@@ -341,14 +494,14 @@ def _allgather_hierarchical(engine, entries, resp: Response):
         # Phase 1: node-local ragged ring allgatherv (raw bytes).
         blocks: List[Optional[bytes]] = [None] * L
         blocks[li] = np.ascontiguousarray(e.array).tobytes()
-        right = engine._data[local[(li + 1) % L]]
+        right = _sender(engine, local[(li + 1) % L])
         left = engine._data[local[(li - 1) % L]]
         for step in range(L - 1):
             send_idx = (li - step) % L
             recv_idx = (li - step - 1) % L
-            t = _send_async(right, blocks[send_idx])
+            ticket = right.send(blocks[send_idx])
             blocks[recv_idx] = _recv(left)
-            t.join()
+            right.wait(ticket)
         node_block = b"".join(blocks)
 
         if li == 0:
@@ -357,20 +510,22 @@ def _allgather_hierarchical(engine, entries, resp: Response):
             nblocks: List[Optional[bytes]] = [None] * C
             nblocks[me] = node_block
             if C > 1:
-                nright = engine._data[((me + 1) % C) * L]
+                nright = _sender(engine, ((me + 1) % C) * L)
                 nleft = engine._data[((me - 1) % C) * L]
                 for step in range(C - 1):
                     send_idx = (me - step) % C
                     recv_idx = (me - step - 1) % C
-                    t = _send_async(nright, nblocks[send_idx])
+                    ticket = nright.send(nblocks[send_idx])
                     nblocks[recv_idx] = _recv(nleft)
-                    t.join()
+                    nright.wait(ticket)
             full = b"".join(nblocks)
-            # Phase 3: fan the full buffer out to the rest of the node.
-            threads = [_send_async(engine._data[r], full)
+            # Phase 3: fan the full buffer out to the rest of the node
+            # on their persistent senders (the seed spawned a thread per
+            # peer per tensor here).
+            tickets = [(_sender(engine, r), _sender(engine, r).send(full))
                        for r in local[1:]]
-            for t in threads:
-                t.join()
+            for s, ticket in tickets:
+                s.wait(ticket)
         else:
             full = _recv(engine._data[local[0]])
 
@@ -425,14 +580,14 @@ def _allgather_flat(engine, entries, resp: Response):
         blocks: List[Optional[np.ndarray]] = [None] * size
         blocks[me] = np.ascontiguousarray(e.array)
         if size > 1:
-            right = engine._data[group[(me + 1) % size]]
+            right = _sender(engine, group[(me + 1) % size])
             left = engine._data[group[(me - 1) % size]]
             for step in range(size - 1):
                 send_idx = (me - step) % size
                 recv_idx = (me - step - 1) % size
-                t = _send_async(right, blocks[send_idx].tobytes())
+                ticket = right.send(blocks[send_idx])
                 payload = _recv(left)
-                t.join()
+                right.wait(ticket)
                 blk = np.frombuffer(payload, dtype=dtype)
                 blocks[recv_idx] = blk.reshape(
                     (first_dims[recv_idx],) + rest_shape)
@@ -466,17 +621,17 @@ def reducescatter(engine, entries, resp: Response):
             continue
         chunks = [arr[bounds[i]:bounds[i + 1]].copy()
                   for i in range(size)]
-        right = engine._data[group[(me + 1) % size]]
+        right = _sender(engine, group[(me + 1) % size])
         left = engine._data[group[(me - 1) % size]]
         # Virtual rank (me-1): the standard walk leaves member r owning
         # chunk (r+1)%size; shifting by one leaves it owning chunk r.
         for step in range(size - 1):
             send_idx = (me - 1 - step) % size
             recv_idx = (me - 2 - step) % size
-            t = _send_async(right, chunks[send_idx].tobytes())
+            ticket = right.send(chunks[send_idx])
             incoming = np.frombuffer(_recv(left), dtype=dtype).reshape(
                 (bounds[recv_idx + 1] - bounds[recv_idx],) + rest).copy()
-            t.join()
+            right.wait(ticket)
             chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
         out = chunks[me]
         if op == ReduceOp.AVERAGE:
@@ -499,11 +654,11 @@ def broadcast(engine, entries, resp: Response):
             results.append(e.array.copy())
             continue
         if rank == root:
-            payload = np.ascontiguousarray(e.array).tobytes()
-            threads = [_send_async(engine._data[r], payload)
+            payload = np.ascontiguousarray(e.array)
+            tickets = [(_sender(engine, r), _sender(engine, r).send(payload))
                        for r in group if r != root]
-            for t in threads:
-                t.join()
+            for s, ticket in tickets:
+                s.wait(ticket)
             results.append(e.array.copy())
         else:
             payload = _recv(engine._data[root])
@@ -538,10 +693,10 @@ def alltoall(engine, entries, resp: Response):
         for step in range(1, size):
             dst = (rank + step) % size
             src = (rank - step) % size
-            t = _send_async(engine._data[group[dst]],
-                            my_blocks[dst].tobytes())
+            sender = _sender(engine, group[dst])
+            ticket = sender.send(my_blocks[dst])
             payload = _recv(engine._data[group[src]])
-            t.join()
+            sender.wait(ticket)
             blk = np.frombuffer(payload, dtype=dtype)
             if rest_shape:
                 blk = blk.reshape((-1,) + rest_shape)

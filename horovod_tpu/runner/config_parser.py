@@ -19,6 +19,8 @@ _ARG_ENV = {
     "cache_capacity": E.CACHE_CAPACITY,
     "hierarchical_allreduce": E.HIERARCHICAL_ALLREDUCE,
     "hierarchical_allgather": E.HIERARCHICAL_ALLGATHER,
+    "ring_segment_bytes": E.RING_SEGMENT_BYTES,
+    "sock_buf_bytes": E.SOCK_BUF_BYTES,
     "timeline_filename": E.TIMELINE,
     "timeline_mark_cycles": E.TIMELINE_MARK_CYCLES,
     "no_stall_check": E.STALL_CHECK_DISABLE,

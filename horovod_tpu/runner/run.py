@@ -215,6 +215,17 @@ def make_parser() -> argparse.ArgumentParser:
                       dest="hierarchical_allreduce")
     tune.add_argument("--hierarchical-allgather", action="store_true",
                       dest="hierarchical_allgather")
+    tune.add_argument("--ring-segment-bytes", type=int,
+                      dest="ring_segment_bytes",
+                      help="segment each ring hop so the next segment's "
+                           "receive overlaps the previous segment's "
+                           "reduce; 0 disables (autotunable; see "
+                           "docs/performance.md)")
+    tune.add_argument("--sock-buf-bytes", type=int,
+                      dest="sock_buf_bytes",
+                      help="SO_SNDBUF/SO_RCVBUF for data-plane sockets "
+                           "in bytes; 0 keeps the kernel default (see "
+                           "docs/performance.md)")
 
     auto = p.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", dest="autotune")
@@ -294,6 +305,12 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               f"(got {args.metrics_port}); each worker binds "
               "metrics-port + local_rank", file=sys.stderr)
         return 2
+    for flag, val in (("--ring-segment-bytes", args.ring_segment_bytes),
+                      ("--sock-buf-bytes", args.sock_buf_bytes)):
+        if val is not None and val < 0:
+            print(f"{_prog_name()}: {flag} must be >= 0 "
+                  f"(got {val}; 0 disables)", file=sys.stderr)
+            return 2
     # Elastic flags: validate at parse time, before any rendezvous/ssh
     # side effects — a bad floor/ceiling or a missing discovery script
     # must fail in milliseconds, not mid-launch.

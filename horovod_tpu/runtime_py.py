@@ -311,6 +311,10 @@ class PyEngine(_EngineBase):
         self.timeline = timeline_mod.from_env(rank)
         self.cycle_time = env_util.cycle_time_ms() / 1e3
         self.fusion_threshold = env_util.fusion_threshold_bytes()
+        # Ring-hop receive segmentation (docs/performance.md); autotunable
+        # like the fusion threshold, receiver-local so any mix of segment
+        # settings (and the native engine) stays wire-compatible.
+        self.ring_segment_bytes = env_util.ring_segment_bytes()
         self.stall_warn_s = env_util.get_float(env_util.STALL_CHECK_TIME, 60.0)
         self.stall_shutdown_s = env_util.get_float(
             env_util.STALL_SHUTDOWN_TIME, 0.0)
@@ -408,7 +412,8 @@ class PyEngine(_EngineBase):
             self._pm = ParameterManager.from_env(
                 self.fusion_threshold, self.cycle_time,
                 self.hierarchical_allreduce, self.hierarchical_allgather,
-                hierarchical_ok=self.hierarchical_topology_ok())
+                hierarchical_ok=self.hierarchical_topology_ok(),
+                ring_segment_bytes=self.ring_segment_bytes)
         self._pending_params = None
 
         self._bootstrap(rdv_addr, rdv_port)
@@ -430,6 +435,18 @@ class PyEngine(_EngineBase):
 
         self._data, self._ctrl_sock, self._ctrl_socks = bootstrap_mesh(
             self.rank, self.size, rdv_addr, rdv_port)
+
+        # Data-plane hot-path state (docs/performance.md): one persistent
+        # sender thread per peer socket — ring hops enqueue sends instead
+        # of spawning a thread per hop — plus the persistent fusion/hop
+        # scratch the collectives pack into.  Torn down in shutdown();
+        # an elastic re-form goes through shutdown() + a fresh engine, so
+        # re-bootstrap always starts from an empty pool.
+        from horovod_tpu.ops.fusion_buffer import FusionBuffer
+
+        self._senders = {r: su.PeerSender(s, name=f"hvd-send-{r}")
+                         for r, s in self._data.items()}
+        self._fusion_buf = FusionBuffer()
 
         # ctrl receiver threads
         if self.rank == 0:
@@ -663,6 +680,16 @@ class PyEngine(_EngineBase):
         self._shutdown_flag.set()
         self._bg.join(timeout=10)
         self.timeline.shutdown()
+        # Stop the persistent senders first (drains queued frames while
+        # the sockets are still open), then close sockets — which also
+        # unblocks any sender stuck mid-write to a dead peer — and join.
+        senders = list(getattr(self, "_senders", {}).values())
+        for snd in senders:
+            try:
+                snd.close(timeout=2.0)
+            except Exception:
+                pass
+        self._senders = {}
         for s in list(self._data.values()) + list(self._ctrl_socks.values()):
             try:
                 s.close()
@@ -673,6 +700,10 @@ class PyEngine(_EngineBase):
                 self._ctrl_sock.close()
             except OSError:
                 pass
+        # Closed sockets error out any sender blocked in a write; bound
+        # the join so shutdown stays prompt even for a wedged thread.
+        for snd in senders:
+            snd.thread.join(timeout=2.0)
 
     # ------------------------------------------------------------------
     # background loop
@@ -857,12 +888,17 @@ class PyEngine(_EngineBase):
         return True
 
     def _apply_params(self, params) -> None:
-        fusion, cycle_s, cache_on, hier_ar, hier_ag = params
+        # 5-tuple frames come from older coordinators (and the native
+        # engine) that predate the ring-segment knob; keep the local
+        # setting in that case.
+        fusion, cycle_s, cache_on, hier_ar, hier_ag = params[:5]
         self.fusion_threshold = fusion
         self.cycle_time = cycle_s
         self._cache_classify_enabled = cache_on
         self.hierarchical_allreduce = hier_ar
         self.hierarchical_allgather = hier_ag
+        if len(params) > 5:
+            self.ring_segment_bytes = params[5]
 
     def hierarchical_topology_ok(self) -> bool:
         """True when the two-level data plane can run: a real local/cross
@@ -1028,7 +1064,9 @@ class PyEngine(_EngineBase):
                 params = (tuned.fusion_threshold, tuned.cycle_time_s,
                           tuned.cache_enabled,
                           tuned.hierarchical_allreduce,
-                          tuned.hierarchical_allgather)
+                          tuned.hierarchical_allgather,
+                          getattr(tuned, "ring_segment_bytes",
+                                  self.ring_segment_bytes))
                 self._pending_params = None
             shared = None
             for r, s in self._ctrl_socks.items():

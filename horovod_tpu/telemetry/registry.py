@@ -89,6 +89,14 @@ KNOWN_METRICS: Dict[str, dict] = {
     "hvd_collective_latency_seconds": _hist(
         "Enqueue-to-completion latency per collective.", *_SECONDS,
         labels=("op", "dtype")),
+    # -- eager data plane (ops/cpu_backend.py; docs/performance.md) --
+    "hvd_ring_hop_seconds": _hist(
+        "Wall time of one ring hop (send enqueue through receive+reduce "
+        "and send completion), labeled by ring phase.", *_SECONDS,
+        labels=("phase",)),
+    "hvd_dataplane_alloc_bytes": _counter(
+        "Bytes allocated growing the persistent data-plane buffers "
+        "(fusion, hop, and fp32 scratch); flat in steady state."),
     # -- response cache (common/response_cache.py via the engine) --
     "hvd_cache_hits_total": _counter(
         "Response-cache hits in request classification."),

@@ -27,6 +27,13 @@ AUTOTUNE_WARMUP_SAMPLES = "HVD_AUTOTUNE_WARMUP_SAMPLES"
 AUTOTUNE_MAX_SAMPLES = "HVD_AUTOTUNE_MAX_SAMPLES"      # BAYES_OPT_MAX_SAMPLES
 AUTOTUNE_SAMPLE_DURATION = "HVD_AUTOTUNE_SAMPLE_DURATION_SECONDS"
 ADASUM_MODE = "HVD_ADASUM_MODE"
+# Eager data plane (horovod_tpu.ops.cpu_backend; docs/performance.md).
+# RING_SEGMENT_BYTES slices each ring hop's receive so reducing segment k
+# overlaps receiving segment k+1 (0 = whole-chunk hops, no segmentation);
+# SOCK_BUF_BYTES, when > 0, sets SO_SNDBUF/SO_RCVBUF on every data-plane
+# socket (both the dialing and the accepting side).
+RING_SEGMENT_BYTES = "HVD_RING_SEGMENT_BYTES"
+SOCK_BUF_BYTES = "HVD_SOCK_BUF_BYTES"
 # Liveness / fault tolerance (PyEngine; 0 = heartbeats disabled).
 # HOROVOD_HEARTBEAT_TIMEOUT is accepted as an alias of the HVD_ name.
 HEARTBEAT_TIMEOUT = "HVD_HEARTBEAT_TIMEOUT"
@@ -105,3 +112,8 @@ def fusion_threshold_bytes() -> int:
 def cycle_time_ms() -> float:
     """Background-loop cadence; reference default 5 ms (operations.cc:416)."""
     return get_float(CYCLE_TIME, 5.0)
+
+
+def ring_segment_bytes() -> int:
+    """Ring-hop segment size; 0 (default) disables segmentation."""
+    return max(0, get_int(RING_SEGMENT_BYTES, 0))

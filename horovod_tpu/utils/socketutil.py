@@ -3,12 +3,27 @@
 The control plane is host-side traffic exactly like the reference's
 (gloo-over-TCP / MPI): tiny framed messages.  Frame = u8 tag, u32 LE length,
 payload.
+
+The data plane additionally gets a zero-copy hot path (docs/performance.md):
+
+* :func:`send_frame_zc` writes header + payload with scatter-gather
+  (``sendmsg``), so neither the header concat nor a ``tobytes()`` copy of
+  the payload happens — the payload memoryview goes straight to the kernel.
+* :func:`recv_exact_into` / :func:`recv_frame_into` receive straight into a
+  caller-owned buffer with ``recv_into`` — no per-chunk ``bytes`` objects,
+  no ``b"".join``.
+* :class:`PeerSender` is a persistent per-socket sender thread fed by a
+  queue: ring hops enqueue a send and overlap it with their receive without
+  spawning a thread per hop (the seed spawned one ``threading.Thread`` per
+  ring step, which dominated small-message latency).
 """
 
 from __future__ import annotations
 
+import collections
 import socket
 import struct
+import threading
 from typing import Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
@@ -28,23 +43,233 @@ def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:
     sock.sendall(HEADER.pack(tag, len(payload)) + payload)
 
 
+def _as_byte_view(payload) -> memoryview:
+    """A flat ``memoryview`` of bytes over ``payload`` without copying.
+
+    Accepts bytes/bytearray/memoryview and C-contiguous numpy arrays —
+    including dtypes whose PEP-3118 format memoryview rejects (bfloat16,
+    fp8): those go through a uint8 reinterpret view of the same memory.
+    """
+    if isinstance(payload, memoryview):
+        return payload.cast("B") if payload.format != "B" else payload
+    if isinstance(payload, (bytes, bytearray)):
+        return memoryview(payload)
+    # numpy array (possibly an extension dtype): reinterpret as raw bytes.
+    import numpy as np
+
+    arr = payload
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def send_frame_zc(sock: socket.socket, tag: int, payload) -> None:
+    """Scatter-gather frame send: header and payload go to the kernel as
+    one ``sendmsg`` (falling back to two ``sendall``s), with the payload
+    read directly from the caller's buffer — zero copies in user space.
+
+    Fires the same ``sock.send`` fault site as :func:`send_frame`, so the
+    chaos harness covers both framings identically.
+    """
+    _fi.fire("sock.send", str(tag))
+    view = _as_byte_view(payload)
+    header = HEADER.pack(tag, len(view))
+    if not len(view):
+        sock.sendall(header)
+        return
+    try:
+        sent = sock.sendmsg([header, view])
+    except (AttributeError, OSError):
+        # No sendmsg (exotic platforms / wrapped sockets): two sendalls —
+        # still no payload copy, just one extra syscall.
+        sock.sendall(header)
+        sock.sendall(view)
+        return
+    total = len(header) + len(view)
+    while sent < total:
+        # Short write: finish the remainder with sendall over views.
+        if sent < len(header):
+            sock.sendall(header[sent:])
+            sock.sendall(view)
+        else:
+            sock.sendall(view[sent - len(header):])
+        return
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Receive exactly ``n`` bytes as a new ``bytes`` object.
+
+    Implemented over one preallocated ``bytearray`` + ``recv_into`` — no
+    per-chunk ``bytes`` objects and no trailing ``b"".join`` (the seed's
+    version allocated both).  The ``sock.recv`` fault site fires exactly
+    once per call, as before, so tests/test_chaos.py semantics hold.
+    """
+    buf = bytearray(n)
+    recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket via ``recv_into``.
+
+    The caller owns the buffer; nothing is allocated here.  Fires the
+    ``sock.recv`` fault-injection site once (same contract as
+    :func:`recv_exact`).
+    """
     _fi.fire("sock.recv")
-    chunks = []
     got = 0
+    n = len(view)
     while got < n:
-        b = sock.recv(min(n - got, 1 << 20))
-        if not b:
+        r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+        if not r:
             raise ConnectionError("peer closed connection")
-        chunks.append(b)
-        got += len(b)
-    return b"".join(chunks)
+        got += r
 
 
 def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
     hdr = recv_exact(sock, HEADER.size)
     tag, n = HEADER.unpack(hdr)
     return tag, recv_exact(sock, n)
+
+
+def recv_frame_into(sock: socket.socket, view: memoryview) -> Tuple[int, int]:
+    """Receive one frame's payload straight into ``view`` (which must be
+    at least the frame's length); returns ``(tag, nbytes)``."""
+    hdr = recv_exact(sock, HEADER.size)
+    tag, n = HEADER.unpack(hdr)
+    if n > len(view):
+        raise ValueError(
+            f"frame payload of {n} bytes exceeds the receive buffer "
+            f"({len(view)} bytes)")
+    recv_exact_into(sock, view[:n])
+    return tag, n
+
+
+def recv_frame_header(sock: socket.socket) -> Tuple[int, int]:
+    """Read just the frame header: ``(tag, payload_len)``.  The caller
+    then drains exactly ``payload_len`` bytes with
+    :func:`recv_exact_into` — in one gulp or in segments (the segmented
+    ring reads a hop in ``HVD_RING_SEGMENT_BYTES`` slices so each
+    slice's reduction overlaps the next slice's receive)."""
+    hdr = recv_exact(sock, HEADER.size)
+    return HEADER.unpack(hdr)
+
+
+def configure_data_socket(sock: socket.socket) -> None:
+    """Socket options for data-plane (and ctrl) mesh connections, applied
+    on BOTH the dialing and the accepting side: ``TCP_NODELAY`` (ring
+    frames are latency-bound; Nagle on the accept side delayed half of
+    every ring link in the seed) and, when ``HVD_SOCK_BUF_BYTES`` is set,
+    matching ``SO_SNDBUF``/``SO_RCVBUF`` so segment pipelining has kernel
+    buffer to overlap into."""
+    from horovod_tpu.utils import env as env_util
+
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not a TCP socket (tests use socketpairs)
+    buf = env_util.get_int(env_util.SOCK_BUF_BYTES, 0)
+    if buf > 0:
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, buf)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, buf)
+        except OSError:
+            pass
+
+
+class PeerSender:
+    """Persistent sender thread for one peer socket.
+
+    Replaces the seed's thread-per-hop ``_send_async``: the thread is
+    created once (at engine bootstrap) and fed through a deque; a ring
+    hop enqueues its chunk view and gets back a ticket (sequence number)
+    to wait on after its receive completes.  Waiting is a counter
+    comparison under a condition variable — no per-send Event object, so
+    the steady-state hop loop allocates nothing.
+
+    Send failures (peer gone) are captured and re-raised at ``wait``, so
+    the hop loop sees a ``ConnectionError`` where the seed's daemon
+    thread silently swallowed it.
+    """
+
+    def __init__(self, sock: socket.socket, name: str = "hvd-send"):
+        self._sock = sock
+        self._deque: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._enq_seq = 0
+        self._done_seq = 0
+        self._fail_seq: Optional[int] = None
+        self._exc: Optional[BaseException] = None
+        self._closing = False
+        self.thread = threading.Thread(
+            target=self._loop, name=name, daemon=True)
+        self.thread.start()
+
+    def send(self, payload, tag: int = TAG_DATA) -> int:
+        """Enqueue one frame; returns the ticket to pass to :meth:`wait`.
+        ``payload`` may be bytes or a (contiguous) numpy array / view —
+        the sender reads it in place, so the region must stay unmodified
+        until ``wait`` returns."""
+        with self._cv:
+            if self._closing:
+                raise ConnectionError("sender is closed")
+            if self._exc is not None:
+                raise ConnectionError(
+                    f"peer send failed: {self._exc!r}") from self._exc
+            self._enq_seq += 1
+            seq = self._enq_seq
+            self._deque.append((seq, tag, payload))
+            self._cv.notify_all()
+        return seq
+
+    def wait(self, seq: int, timeout: Optional[float] = None) -> None:
+        """Block until ticket ``seq`` has hit the kernel (or raise the
+        send error that stopped the thread)."""
+        with self._cv:
+            while self._done_seq < seq and self._exc is None:
+                if not self._cv.wait(timeout):
+                    raise TimeoutError("send did not complete in time")
+            if self._exc is not None and self._fail_seq is not None \
+                    and seq >= self._fail_seq:
+                # This ticket (or an earlier one it was queued behind)
+                # never reached the kernel.
+                raise ConnectionError(
+                    f"peer send failed: {self._exc!r}") from self._exc
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the thread (after draining already-enqueued sends)."""
+        with self._cv:
+            if self._closing:
+                self.thread.join(timeout)
+                return
+            self._closing = True
+            self._cv.notify_all()
+        self.thread.join(timeout)
+
+    # -- internal ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._deque and not self._closing:
+                    self._cv.wait()
+                if not self._deque and self._closing:
+                    return
+                seq, tag, payload = self._deque.popleft()
+            try:
+                if self._exc is None:
+                    send_frame_zc(self._sock, tag, payload)
+            except BaseException as e:  # surface at wait()
+                with self._cv:
+                    self._exc = e
+                    if self._fail_seq is None:
+                        self._fail_seq = seq
+                    self._cv.notify_all()
+            # _done_seq advances even past a failure so close() and
+            # wait() never hang; wait() raises via _fail_seq instead.
+            with self._cv:
+                self._done_seq = seq
+                self._cv.notify_all()
 
 
 def listen_on(host: str = "0.0.0.0", port: int = 0) -> socket.socket:
@@ -74,7 +299,7 @@ def connect_retry(host: str, port: int, timeout: float = 30.0,
         try:
             _fi.fire("sock.connect", f"{host}:{port}")
             s = socket.create_connection((host, port), timeout=5.0)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            configure_data_socket(s)
             s.settimeout(None)
             return s
         except OSError as e:

@@ -897,6 +897,48 @@ def scenario_autotune_converges():
             assert max(fused) > max(unfused), (fused, unfused)
 
 
+def scenario_dataplane_threads():
+    """Persistent-sender pool hygiene (docs/performance.md): the eager
+    data plane keeps one long-lived ``hvd-send-*`` thread per peer it
+    has sent to — steady-state traffic spawns nothing (the seed spawned
+    a thread per ring hop) — and shutdown reaps every one."""
+    import threading
+    import time
+
+    from horovod_tpu import basics
+
+    if type(basics._runtime).__name__ != "PyEngine":
+        return  # sender threads are a py-engine implementation detail
+
+    rank, size = hvd.rank(), hvd.size()
+
+    def senders():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("hvd-send-")]
+
+    for i in range(3):
+        hvd.allreduce(np.arange(4096, dtype=np.float32), op=hvd.Sum,
+                      name=f"dp.warm{i}")
+    baseline = senders()
+    assert 0 < len(baseline) <= size - 1, [t.name for t in baseline]
+    for i in range(5):
+        hvd.allreduce(np.arange(4096, dtype=np.float32) * i, op=hvd.Sum,
+                      name=f"dp.t{i}")
+        hvd.allgather(np.ones((rank + 1, 2), np.float32),
+                      name=f"dp.ag{i}")
+        hvd.broadcast(np.ones(8, np.float32), root_rank=i % size,
+                      name=f"dp.bc{i}")
+    after = senders()
+    assert {t.ident for t in after} == {t.ident for t in baseline}, (
+        "steady-state traffic changed the sender pool: "
+        f"{[t.name for t in after]} vs {[t.name for t in baseline]}")
+    hvd.shutdown()  # second shutdown in main() is a no-op
+    deadline = time.monotonic() + 10.0
+    while senders() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not senders(), [t.name for t in senders()]
+
+
 def scenario_cache_disabled():
     rank, size = hvd.rank(), hvd.size()
     for _ in range(3):

@@ -62,11 +62,27 @@ def main():
                 time.sleep(step_sleep)
 
     train(state)
+    # Persistent-sender hygiene across elastic re-forms: each re-formed
+    # mesh tears down the old pool, so at most size-1 hvd-send-* threads
+    # exist now, and zero survive shutdown (docs/performance.md).
+    import threading
+
+    def senders():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("hvd-send-")]
+
+    assert len(senders()) <= hvd.size() - 1, \
+        f"sender pool leaked across re-forms: {[t.name for t in senders()]}"
     print(f"FINAL_W {float(state.w[0])}", flush=True)
     print(f"FINAL_EPOCH {os.environ.get('HVD_ELASTIC_EPOCH', '0')}",
           flush=True)
     print("DONE", flush=True)
     hvd.shutdown()
+    deadline = time.monotonic() + 10.0
+    while senders() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not senders(), \
+        f"sender threads survived shutdown: {[t.name for t in senders()]}"
 
 
 if __name__ == "__main__":

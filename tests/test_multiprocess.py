@@ -364,6 +364,27 @@ def test_np8_hierarchical_gang(engine):
         assert_gang(s, 8, engine, profile="hier")
 
 
+def test_dataplane_sender_threads():
+    """Persistent-sender pool smoke on a live py-engine gang: one
+    ``hvd-send-*`` thread per peer, stable across steady-state traffic,
+    all reaped at shutdown (the in-process contracts live in
+    tests/test_dataplane.py; this is the live-gang proof)."""
+    run_workers("dataplane_threads", 3, engine="py")
+
+
+def test_segmented_ring_gang():
+    """Receiver-side ring segmentation on a live gang: segmentation is
+    receiver-local (one frame per hop on the wire), so with a segment
+    size far below the chunk size every op-semantics assertion of the
+    allreduce/fusion scenarios must still hold bit-for-bit.
+    (Mixed segmented/unsegmented peers are pinned in-process by
+    tests/test_dataplane.py::test_mixed_segmentation_interoperates.)"""
+    status = run_gang(run_workers, ["allreduce", "fusion"], np_=2,
+                      engine="py",
+                      extra_env={"HVD_RING_SEGMENT_BYTES": "64"})
+    assert status["__gang__"] == "OK", status
+
+
 @pytest.mark.parametrize("engine", ENGINES + ["mixed"])
 def test_bridge_jit(engine):
     """Jitted-step collectives ride the negotiated engine, bitwise equal
