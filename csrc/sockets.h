@@ -19,6 +19,11 @@ namespace hvd {
 constexpr uint8_t kTagRequestList = 1;
 constexpr uint8_t kTagResponseList = 2;
 constexpr uint8_t kTagData = 3;
+// Tags 4-9 are reserved by the Python engine's control-plane
+// extensions (KV tunneling, heartbeats, and the collective-abort
+// agreement: abort-report / probe / probe-ack / abort-verdict — see
+// horovod_tpu/utils/socketutil.py and common/wire.py).  The native
+// engine never sends or expects them; do not reuse the numbers.
 
 struct SocketError : std::runtime_error {
   explicit SocketError(const std::string& what) : std::runtime_error(what) {}

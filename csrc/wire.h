@@ -4,6 +4,12 @@
 // uses FlatBuffers).  The layout here is the hand-rolled little-endian
 // encoding specified in horovod_tpu/common/wire.py — THE TWO MUST MATCH;
 // both engines speak this format on the same sockets.
+//
+// The Python engine additionally defines collective-abort agreement
+// payloads (AbortReport / ProbeAck / AbortVerdict, wire.py) carried on
+// reserved control tags 6-9 (sockets.h).  They have no C++ mirror: the
+// native engine ignores HVD_COLLECTIVE_TIMEOUT — the knob only takes
+// effect on PyEngine gangs (runtime_py.py).
 #pragma once
 
 #include <cstdint>

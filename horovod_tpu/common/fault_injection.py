@@ -33,13 +33,20 @@ Fault fields:
   SIGKILL-style death a supervisor sees), ``corrupt`` (data-plane
   poisoning: fires only at :func:`should_corrupt` sites, where the
   call site itself applies the corruption — NaN gradients, a flipped
-  bit, a torn checkpoint file).
+  bit, a torn checkpoint file), ``stall`` (sleep ``stall_s`` —
+  hour-scale by default, i.e. an "indefinite" hang: a GC pause, a
+  wedged peer, a partition that heals — then continue normally),
+  ``halfopen`` (sleep ``stall_s`` then raise, modeling a half-open TCP
+  connection whose blackholed writes the kernel eventually errors).
 * ``match``  — substring that must appear in the call's ``detail``.
 * ``times``  — fire at most this many times (default: unlimited).
 * ``after``  — skip the first N matching passes (default 0).
 * ``prob``   — fire with this probability, drawn from a PRNG seeded by
   the plan ``seed`` (default: always fire).
 * ``delay_s``— sleep duration for ``kind: delay`` (default 0.1).
+* ``stall_s``— hang duration for ``stall`` / ``halfopen`` (default
+  3600 — "forever" at test scale, yet the injected sleeper thread
+  still unwinds instead of leaking for the life of the process).
 """
 
 from __future__ import annotations
@@ -74,6 +81,8 @@ KNOWN_SITES = {
     "engine.cycle": "PyEngine background cycle",
     "ctrl.worker.send": "worker->coordinator control send",
     "ctrl.coord.send": "coordinator->worker control send",
+    "sock.stall": "data-plane ring-hop receive (hang simulation)",
+    "sock.halfopen": "persistent sender thread send (half-open sim)",
     "train.step": "user-level per-step site (training scripts)",
     # data plane (should_corrupt)
     "grad.nonfinite": "poison local gradients with NaN (eager guard)",
@@ -93,18 +102,20 @@ class InjectedFault(ConnectionError):
 
 class _Fault:
     __slots__ = ("site", "kind", "match", "times", "after", "prob",
-                 "delay_s", "hits", "fired")
+                 "delay_s", "stall_s", "hits", "fired")
 
     def __init__(self, spec: dict):
         self.site = spec["site"]
         self.kind = spec.get("kind", "error")
-        if self.kind not in ("drop", "error", "delay", "kill", "corrupt"):
+        if self.kind not in ("drop", "error", "delay", "kill", "corrupt",
+                             "stall", "halfopen"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         self.match = spec.get("match")
         self.times = spec.get("times")
         self.after = int(spec.get("after", 0))
         self.prob = spec.get("prob")
         self.delay_s = float(spec.get("delay_s", 0.1))
+        self.stall_s = float(spec.get("stall_s", 3600.0))
         self.hits = 0    # matching passes seen
         self.fired = 0   # faults actually injected
 
@@ -157,6 +168,14 @@ def _fire_slow(plan: _Plan, site: str, detail: str) -> None:
         if f.kind == "delay":
             time.sleep(f.delay_s)
             continue
+        if f.kind == "stall":
+            time.sleep(f.stall_s)
+            continue
+        if f.kind == "halfopen":
+            time.sleep(f.stall_s)
+            raise InjectedFault(
+                f"injected halfopen at {site!r}"
+                + (f" ({detail})" if detail else ""))
         if f.kind == "kill":
             os._exit(137)
         raise InjectedFault(

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 
 class DataType(enum.IntEnum):
@@ -175,6 +175,37 @@ class ReplicaDivergenceError(RanksFailedError):
             f"from the last commit/checkpoint",)
 
 
+class CollectiveTimeoutError(RanksFailedError):
+    """A collective blew past ``HVD_COLLECTIVE_TIMEOUT`` and the gang
+    agreed to abort it.
+
+    The rank that timed out locally reports the peer it was blocked on
+    to the coordinator over the still-live control channel; the
+    coordinator confirms with a probe round and broadcasts a verdict
+    naming the wedged rank(s), so every survivor raises this *same*
+    exception for the *same* step (mirroring the non-finite agreement
+    of ``horovod_tpu.integrity``).
+
+    Subclasses :class:`RanksFailedError` with ``.ranks`` = the wedged
+    rank(s), so ``@hvd.elastic.run`` treats a hung rank exactly like a
+    dead one: evict, re-form, and replay the aborted fused batch from
+    the retained inputs (``ops.fusion_buffer``).
+    """
+
+    def __init__(self, ranks, tensor_name: str = "",
+                 timeout_s: float = 0.0):
+        self.tensor_name = tensor_name
+        self.timeout_s = float(timeout_s)
+        RuntimeError.__init__(self)  # skip RanksFailedError's message
+        self.ranks = sorted(int(r) for r in ranks)
+        detail = f" during {tensor_name!r}" if tensor_name else ""
+        self.args = (
+            f"collective timed out after {self.timeout_s:g}s{detail}: "
+            f"the gang agreed rank(s) {self.ranks} are wedged (hung, "
+            f"not dead — heartbeats alone cannot catch this); evict "
+            f"the wedged rank(s) and replay the aborted batch",)
+
+
 class StatusType(enum.IntEnum):
     OK = 0
     UNKNOWN_ERROR = 1
@@ -193,6 +224,12 @@ class Status:
 
     type: StatusType = StatusType.OK
     reason: str = ""
+    # Optional typed exception: when set, HandleManager.wait re-raises
+    # THIS object instead of wrapping ``reason`` in a bare RuntimeError,
+    # so gang-agreed failures (CollectiveTimeoutError, ...) keep their
+    # class — ``@hvd.elastic.run`` dispatches on it.  Python-side only;
+    # never serialized (csrc/wire.h carries reason strings as before).
+    exc: Optional[BaseException] = None
 
     @staticmethod
     def ok() -> "Status":

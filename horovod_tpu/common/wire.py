@@ -57,6 +57,17 @@ The cache fields carry the response-cache fast path (parity:
 bitvector allreduced across ranks; here explicit hit events up to the
 coordinator and hit positions back down, see
 ``horovod_tpu/common/response_cache.py``).
+
+Collective-abort agreement payloads (Python engine only — ridden on the
+``TAG_ABORT_REPORT`` / ``TAG_PROBE_ACK`` / ``TAG_ABORT_VERDICT``
+control tags, which like ``TAG_HEARTBEAT`` do not exist in
+csrc/sockets.h; the native engine never negotiates a collective
+timeout, so these codecs need no C++ mirror — only the tag-number
+reservation is noted in csrc/wire.h):
+
+  AbortReport  := varstr tensor_name, i32 suspect_rank, u32 epoch
+  ProbeAck     := u8 busy, f64 busy_seconds, u32 epoch
+  AbortVerdict := varstr tensor_name, u32 n, i32 ranks[n], u32 epoch
 """
 
 from __future__ import annotations
@@ -317,3 +328,61 @@ def decode_response_list(data: bytes) -> Tuple[
     if off + 4 <= len(data):  # pre-trailer encoders stop here
         (epoch,) = struct.unpack_from("<I", data, off)
     return out, bool(shutdown), hits, resend, params, epoch
+
+
+# -- collective-abort agreement payloads (docs/fault_tolerance.md) -----
+
+
+def encode_abort_report(tensor_name: str, suspect_rank: int,
+                        epoch: int = 0) -> bytes:
+    """Worker -> coordinator: a local hop timeout during
+    ``tensor_name``, blocked on ``suspect_rank`` (-1 = unknown)."""
+    buf = bytearray()
+    _pack_str(buf, tensor_name)
+    buf += struct.pack("<iI", suspect_rank, epoch)
+    return bytes(buf)
+
+
+def decode_abort_report(data: bytes) -> Tuple[str, int, int]:
+    name, off = _unpack_str(data, 0)
+    suspect, epoch = struct.unpack_from("<iI", data, off)
+    return name, suspect, epoch
+
+
+def encode_probe_ack(busy: bool, busy_seconds: float,
+                     epoch: int = 0) -> bytes:
+    """Worker -> coordinator: probe answer.  ``busy`` = a collective is
+    executing right now; ``busy_seconds`` = for how long."""
+    return struct.pack("<BdI", 1 if busy else 0, busy_seconds, epoch)
+
+
+def decode_probe_ack(data: bytes) -> Tuple[bool, float, int]:
+    busy, busy_seconds, epoch = struct.unpack_from("<BdI", data, 0)
+    return bool(busy), busy_seconds, epoch
+
+
+def encode_abort_verdict(tensor_name: str, ranks,
+                         epoch: int = 0) -> bytes:
+    """Coordinator -> workers: the gang-agreed wedged rank set for the
+    collective named ``tensor_name``."""
+    buf = bytearray()
+    _pack_str(buf, tensor_name)
+    ranks = sorted(int(r) for r in ranks)
+    buf += struct.pack("<I", len(ranks))
+    for r in ranks:
+        buf += struct.pack("<i", r)
+    buf += struct.pack("<I", epoch)
+    return bytes(buf)
+
+
+def decode_abort_verdict(data: bytes) -> Tuple[str, List[int], int]:
+    name, off = _unpack_str(data, 0)
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    ranks = []
+    for _ in range(n):
+        (r,) = struct.unpack_from("<i", data, off)
+        off += 4
+        ranks.append(r)
+    (epoch,) = struct.unpack_from("<I", data, off)
+    return name, ranks, epoch

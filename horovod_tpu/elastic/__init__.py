@@ -14,7 +14,10 @@ from horovod_tpu.elastic.driver import (  # noqa: F401
     HostDiscoveryScript,
     HostsUpdatedInterrupt,
 )
-from horovod_tpu.elastic.run import run  # noqa: F401
+from horovod_tpu.elastic.run import (  # noqa: F401
+    last_replay_results,
+    run,
+)
 from horovod_tpu.elastic.state import (  # noqa: F401
     KerasState,
     ObjectState,

@@ -262,6 +262,56 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
                  new_epoch, new_rank, len(world))
 
 
+# -- evict-and-replay ---------------------------------------------------
+# When the failure was a CollectiveTimeoutError, every survivor retained
+# copies of the aborted fused reduction's original inputs
+# (runtime_py.retain_aborted_batch); after the re-form the wrapper
+# replays them so the batch is not lost with the wedged rank.
+
+_last_replay: Optional[dict] = None
+
+
+def last_replay_results() -> Optional[dict]:
+    """Results of the most recent evict-and-replay (original tensor
+    name -> reduced array over the re-formed gang), or None if no
+    replay has run in this process."""
+    return _last_replay
+
+
+def _replay_aborted_batch(ctx: _ElasticContext,
+                          old_roster: List[str]) -> None:
+    global _last_replay
+    from horovod_tpu import runtime_py
+    from horovod_tpu.ops import eager
+
+    batch = runtime_py.take_retained_batch()
+    if not batch:
+        return
+    if not set(ctx.roster) <= set(old_roster):
+        # A joiner was admitted in this re-form: it holds no retained
+        # inputs, so a survivor-only replay would desync the global
+        # negotiation.  Drop the batch — the training loop restarts
+        # from its last commit instead.
+        ctx.log.warning(
+            "dropping the retained aborted batch: new worker(s) "
+            "joined during the re-form")
+        return
+    # Async-submit the whole batch so the coordinator re-fuses it like
+    # the original launch; names are epoch-scoped so the replay never
+    # collides with the training loop's own tensor names.
+    handles = [
+        (item["name"], eager.allreduce_async(
+            item["array"], name=f"replay.e{ctx.epoch}.{item['name']}",
+            op=item["op"], prescale_factor=item["prescale"],
+            postscale_factor=item["postscale"]))
+        for item in batch]
+    _last_replay = {nm: eager.synchronize(h) for nm, h in handles}
+    _timeline_event("ELASTIC_REPLAY", epoch=ctx.epoch,
+                    tensors=len(handles))
+    ctx.log.info("replayed %d aborted tensor(s) on the re-formed gang",
+                 len(handles))
+
+
 def _join_as_new_worker(ctx: _ElasticContext) -> None:
     """Late worker: announce, then block for an epoch assignment instead
     of bootstrapping at epoch 0."""
@@ -305,7 +355,10 @@ def run(func):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
         from horovod_tpu import basics
-        from horovod_tpu.common.types import RanksFailedError
+        from horovod_tpu.common.types import (
+            CollectiveTimeoutError,
+            RanksFailedError,
+        )
 
         # The native engine has no in-process reset path; elastic always
         # runs the Python engine (hvdrun does the same).
@@ -334,6 +387,7 @@ def run(func):
             ctx.maybe_start_driver()
         try:
             while True:
+                replay = False
                 try:
                     if joined:
                         # First sync delivers the gang's state (and the
@@ -344,6 +398,10 @@ def run(func):
                     return func(state, *args, **kwargs)
                 except RanksFailedError as e:
                     failed = set(e.ranks)
+                    # A gang-agreed collective abort (hung rank, not a
+                    # dead one) leaves the fused batch's inputs retained
+                    # on every survivor: replay after the re-form.
+                    replay = isinstance(e, CollectiveTimeoutError)
                 except HostsUpdatedInterrupt:
                     failed = set()
                 except RuntimeError:
@@ -352,10 +410,13 @@ def run(func):
                         raise
                     # The star's hub died: that is a failure of rank 0.
                     failed = {0}
+                old_roster = list(ctx.roster)
                 _reform(ctx, failed)
                 state.on_reset()
                 state.restore()
                 state.sync()
+                if replay:
+                    _replay_aborted_batch(ctx, old_roster)
         finally:
             ctx.stop_driver()
             state._elastic_ctx = None

@@ -40,9 +40,11 @@ from typing import List, Optional
 
 import numpy as np
 
+from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.types import DataType, ReduceOp, Response
 from horovod_tpu.ops.fusion_buffer import FusionBuffer
 from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 
 
@@ -50,6 +52,58 @@ def _np_dtype(dt: DataType):
     from horovod_tpu.runtime_py import _np_dtype as f
 
     return f(dt)
+
+
+class HopTimeout(TimeoutError):
+    """A ring hop blocked past the collective deadline.
+
+    Carries the global rank this rank was blocked on (``peer``) so the
+    engine can report the suspect to the coordinator for the gang-wide
+    abort agreement (docs/fault_tolerance.md, "hung ranks vs dead
+    ranks").  ``peer`` is -1 when the blocking side is unknown.
+    """
+
+    def __init__(self, peer: int, phase: str):
+        super().__init__(
+            f"ring hop ({phase}) blocked past the collective deadline "
+            f"waiting on rank {peer}")
+        self.peer = int(peer)
+        self.phase = phase
+
+
+def _deadline(engine) -> Optional[float]:
+    """Absolute ``time.monotonic()`` deadline for a collective starting
+    now, or ``None`` when ``HVD_COLLECTIVE_TIMEOUT`` is off (bare test
+    engines carry no knob attribute — also ``None``, the block-forever
+    behavior the deadline subsystem replaces only on request)."""
+    t = float(getattr(engine, "collective_timeout", 0.0) or 0.0)
+    return (time.monotonic() + t) if t > 0 else None
+
+
+def _wait_send(sender: su.PeerSender, ticket: int,
+               deadline: Optional[float], peer: int) -> None:
+    """``PeerSender.wait`` with a timeout ALWAYS set: the collective
+    deadline when one is active, else the generous always-on
+    ``HVD_SEND_WAIT_CAP_S`` backstop — a dead sender thread must never
+    hang a hop silently."""
+    if deadline is None:
+        cap = max(0.001, env_util.send_wait_cap_s())
+    else:
+        cap = max(0.001, deadline - time.monotonic())
+    try:
+        sender.wait(ticket, cap)
+    except HopTimeout:
+        raise
+    except TimeoutError:
+        raise HopTimeout(peer, "send") from None
+
+
+def _recv_exact_hop(sock, view: memoryview, deadline: Optional[float],
+                    peer: int) -> None:
+    try:
+        su.recv_exact_into(sock, view, deadline)
+    except TimeoutError:
+        raise HopTimeout(peer, "recv") from None
 
 
 def _sender(engine, rank: int) -> su.PeerSender:
@@ -81,29 +135,40 @@ def _segment_elems(engine, itemsize: int) -> int:
     return max(1, seg // itemsize)
 
 
-def _recv(sock) -> bytes:
-    tag, payload = su.recv_frame(sock)
+def _recv(sock, deadline: Optional[float] = None, peer: int = -1) -> bytes:
+    _fi.fire("sock.stall")
+    try:
+        tag, payload = su.recv_frame(sock, deadline)
+    except TimeoutError:
+        raise HopTimeout(peer, "recv") from None
     if tag != su.TAG_DATA:
         raise ConnectionError(f"expected data frame, got tag {tag}")
     return payload
 
 
-def _recv_data_header(sock) -> int:
-    tag, nbytes = su.recv_frame_header(sock)
+def _recv_data_header(sock, deadline: Optional[float] = None,
+                      peer: int = -1) -> int:
+    _fi.fire("sock.stall")
+    try:
+        tag, nbytes = su.recv_frame_header(sock, deadline)
+    except TimeoutError:
+        raise HopTimeout(peer, "recv") from None
     if tag != su.TAG_DATA:
         raise ConnectionError(f"expected data frame, got tag {tag}")
     return nbytes
 
 
-def _recv_into(sock, dst: np.ndarray) -> None:
+def _recv_into(sock, dst: np.ndarray, deadline: Optional[float] = None,
+               peer: int = -1) -> None:
     """Receive one data frame straight into ``dst`` (contiguous view)."""
-    nbytes = _recv_data_header(sock)
+    nbytes = _recv_data_header(sock, deadline, peer)
     if nbytes != dst.nbytes:
         raise ConnectionError(
             f"ring hop size mismatch: got {nbytes} bytes, expected "
             f"{dst.nbytes}")
     if nbytes:
-        su.recv_exact_into(sock, memoryview(dst.view(np.uint8)))
+        _recv_exact_hop(sock, memoryview(dst.view(np.uint8)), deadline,
+                        peer)
 
 
 def _needs_f32_math(dtype: np.dtype) -> bool:
@@ -169,14 +234,15 @@ def _combine_into(incoming: np.ndarray, mine: np.ndarray, op: ReduceOp,
 
 def _recv_combine(sock, mine: np.ndarray, hop: np.ndarray,
                   hop_mv: memoryview, op: ReduceOp, seg: int,
-                  fb: FusionBuffer) -> None:
+                  fb: FusionBuffer, deadline: Optional[float] = None,
+                  peer: int = -1) -> None:
     """Receive one hop's chunk and reduce it into ``mine`` in place.
 
     With ``seg`` > 0, the payload is drained in ``seg``-element slices:
     while numpy reduces slice k, the kernel keeps receiving slice k+1
     into the socket buffer — the DeAR-style transfer/reduction overlap,
     with no extra threads and no wire-format change."""
-    nbytes = _recv_data_header(sock)
+    nbytes = _recv_data_header(sock, deadline, peer)
     n = mine.size
     isz = mine.itemsize
     if nbytes != n * isz:
@@ -186,13 +252,14 @@ def _recv_combine(sock, mine: np.ndarray, hop: np.ndarray,
     if n == 0:
         return
     if seg <= 0 or seg >= n:
-        su.recv_exact_into(sock, hop_mv[:nbytes])
+        _recv_exact_hop(sock, hop_mv[:nbytes], deadline, peer)
         _combine_into(hop[:n], mine, op, fb)
         return
     done = 0
     while done < n:
         k = min(seg, n - done)
-        su.recv_exact_into(sock, hop_mv[done * isz:(done + k) * isz])
+        _recv_exact_hop(sock, hop_mv[done * isz:(done + k) * isz],
+                        deadline, peer)
         _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
         done += k
 
@@ -212,11 +279,12 @@ def ring_allreduce_flat(engine, flat: np.ndarray,
     the reduced result is returned as a new array."""
     group = list(range(engine.size))
     return _ring_allreduce_group(engine, flat.copy(), op, group,
-                                 engine.rank)
+                                 engine.rank, _deadline(engine))
 
 
 def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
-                          group, me: int) -> np.ndarray:
+                          group, me: int,
+                          deadline: Optional[float] = None) -> np.ndarray:
     """Ring allreduce restricted to ``group`` (global ranks, any order);
     ``me`` is this rank's index within it.  Same chunk walk as the C++
     engine (RingAllreduceGroup) so mixed jobs stay bit-identical.
@@ -228,8 +296,10 @@ def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
     size = len(group)
     if size == 1:
         return flat
-    right = _sender(engine, group[(me + 1) % size])
-    left = engine._data[group[(me - 1) % size]]
+    right_rank = group[(me + 1) % size]
+    left_rank = group[(me - 1) % size]
+    right = _sender(engine, right_rank)
+    left = engine._data[left_rank]
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, size)
     max_chunk = max(bounds[i + 1] - bounds[i] for i in range(size))
@@ -246,8 +316,8 @@ def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
         recv_idx = (me - step - 1) % size
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
         _recv_combine(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
-                      hop, hop_mv, op, seg, fb)
-        right.wait(ticket)
+                      hop, hop_mv, op, seg, fb, deadline, left_rank)
+        _wait_send(right, ticket, deadline, right_rank)
         if timed:
             _tmx.observe("hvd_ring_hop_seconds",
                          time.perf_counter() - t0, ("reduce_scatter",))
@@ -258,8 +328,9 @@ def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
         send_idx = (me + 1 - step) % size
         recv_idx = (me - step) % size
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
-        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]])
-        right.wait(ticket)
+        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
+                   deadline, left_rank)
+        _wait_send(right, ticket, deadline, right_rank)
         if timed:
             _tmx.observe("hvd_ring_hop_seconds",
                          time.perf_counter() - t0, ("allgather",))
@@ -277,8 +348,9 @@ def _cross_group(engine):
     return [k * L + engine.local_rank for k in range(engine.cross_size)]
 
 
-def hierarchical_allreduce_flat(engine, flat: np.ndarray,
-                                op: ReduceOp) -> np.ndarray:
+def hierarchical_allreduce_flat(engine, flat: np.ndarray, op: ReduceOp,
+                                deadline: Optional[float] = None
+                                ) -> np.ndarray:
     """Two-level allreduce: local ring reduce-scatter → cross ring
     allreduce of the owned 1/local_size slice → local ring allgather.
 
@@ -292,8 +364,10 @@ def hierarchical_allreduce_flat(engine, flat: np.ndarray,
     L = engine.local_size
     li = engine.local_rank
     local = _local_group(engine)
-    right = _sender(engine, local[(li + 1) % L])
-    left = engine._data[local[(li - 1) % L]]
+    right_rank = local[(li + 1) % L]
+    left_rank = local[(li - 1) % L]
+    right = _sender(engine, right_rank)
+    left = engine._data[left_rank]
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, L)
     max_chunk = max(bounds[i + 1] - bounds[i] for i in range(L))
@@ -308,8 +382,8 @@ def hierarchical_allreduce_flat(engine, flat: np.ndarray,
         recv_idx = (li - step - 1) % L
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
         _recv_combine(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
-                      hop, hop_mv, op, seg, fb)
-        right.wait(ticket)
+                      hop, hop_mv, op, seg, fb, deadline, left_rank)
+        _wait_send(right, ticket, deadline, right_rank)
 
     # Phase 2: cross-node ring allreduce of the fully-reduced owned
     # chunk, in place on its slice of the fusion buffer.
@@ -317,20 +391,22 @@ def hierarchical_allreduce_flat(engine, flat: np.ndarray,
     own_slice = flat[bounds[own]:bounds[own + 1]]
     if own_slice.size:
         _ring_allreduce_group(engine, own_slice, op, _cross_group(engine),
-                              engine.cross_rank)
+                              engine.cross_rank, deadline)
 
     # Phase 3: local ring allgather.
     for step in range(L - 1):
         send_idx = (li + 1 - step) % L
         recv_idx = (li - step) % L
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
-        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]])
-        right.wait(ticket)
+        _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
+                   deadline, left_rank)
+        _wait_send(right, ticket, deadline, right_rank)
 
     return flat
 
 
-def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
+def _adasum_flat(engine, flat: np.ndarray,
+                 deadline: Optional[float] = None) -> np.ndarray:
     """Eager Adasum via recursive distance-doubling partner exchange.
     Power-of-two sizes only (the reference's VHDD also specializes
     power-of-two and handles the remainder separately — not needed for TPU
@@ -349,8 +425,9 @@ def _adasum_flat(engine, flat: np.ndarray) -> np.ndarray:
         sock = engine._data[partner]
         sender = _sender(engine, partner)
         ticket = sender.send(acc)
-        other = np.frombuffer(_recv(sock), dtype=np.float64).copy()
-        sender.wait(ticket)
+        other = np.frombuffer(_recv(sock, deadline, partner),
+                              dtype=np.float64).copy()
+        _wait_send(sender, ticket, deadline, partner)
         if rank < partner:
             acc = adasum_pair_numpy(acc, other)
         else:
@@ -387,7 +464,7 @@ class _AllreduceCandidate:
         raise NotImplementedError
 
     def execute(self, engine, flat: np.ndarray, op: ReduceOp,
-                group, me) -> np.ndarray:
+                group, me, deadline=None) -> np.ndarray:
         raise NotImplementedError
 
 
@@ -400,8 +477,8 @@ class AdasumAllreduce(_AllreduceCandidate):
             and not resp.process_set_id \
             and not getattr(engine, "_evicted_ranks", None)
 
-    def execute(self, engine, flat, op, group, me):
-        return _adasum_flat(engine, flat)
+    def execute(self, engine, flat, op, group, me, deadline=None):
+        return _adasum_flat(engine, flat, deadline)
 
 
 class HierarchicalAllreduce(_AllreduceCandidate):
@@ -412,16 +489,17 @@ class HierarchicalAllreduce(_AllreduceCandidate):
                 and getattr(engine, "hierarchical_allreduce", False)
                 and engine.hierarchical_topology_ok())
 
-    def execute(self, engine, flat, op, group, me):
-        return hierarchical_allreduce_flat(engine, flat, op)
+    def execute(self, engine, flat, op, group, me, deadline=None):
+        return hierarchical_allreduce_flat(engine, flat, op, deadline)
 
 
 class RingAllreduce(_AllreduceCandidate):
     def enabled(self, engine, resp):
         return True
 
-    def execute(self, engine, flat, op, group, me):
-        return _ring_allreduce_group(engine, flat, op, group, me)
+    def execute(self, engine, flat, op, group, me, deadline=None):
+        return _ring_allreduce_group(engine, flat, op, group, me,
+                                     deadline)
 
 
 # Priority order mirrors the reference's CreateOperationManager chain
@@ -457,8 +535,8 @@ def allreduce(engine, entries, resp: Response):
 
     group, me = resp_group(engine, resp)
     reduced = next(c for c in ALLREDUCE_CHAIN
-                   if c.enabled(engine, resp)).execute(engine, flat, op,
-                                                       group, me)
+                   if c.enabled(engine, resp)).execute(
+                       engine, flat, op, group, me, _deadline(engine))
     fused = fused and reduced is flat
 
     if op == ReduceOp.AVERAGE:
@@ -485,6 +563,7 @@ def _allgather_hierarchical(engine, entries, resp: Response):
     L, li = engine.local_size, engine.local_rank
     C = engine.cross_size
     local = _local_group(engine)
+    dl = _deadline(engine)
     results = []
     for e in entries:
         dtype = _np_dtype(resp.tensor_type)
@@ -494,14 +573,16 @@ def _allgather_hierarchical(engine, entries, resp: Response):
         # Phase 1: node-local ragged ring allgatherv (raw bytes).
         blocks: List[Optional[bytes]] = [None] * L
         blocks[li] = np.ascontiguousarray(e.array).tobytes()
-        right = _sender(engine, local[(li + 1) % L])
-        left = engine._data[local[(li - 1) % L]]
+        right_rank = local[(li + 1) % L]
+        left_rank = local[(li - 1) % L]
+        right = _sender(engine, right_rank)
+        left = engine._data[left_rank]
         for step in range(L - 1):
             send_idx = (li - step) % L
             recv_idx = (li - step - 1) % L
             ticket = right.send(blocks[send_idx])
-            blocks[recv_idx] = _recv(left)
-            right.wait(ticket)
+            blocks[recv_idx] = _recv(left, dl, left_rank)
+            _wait_send(right, ticket, dl, right_rank)
         node_block = b"".join(blocks)
 
         if li == 0:
@@ -510,24 +591,26 @@ def _allgather_hierarchical(engine, entries, resp: Response):
             nblocks: List[Optional[bytes]] = [None] * C
             nblocks[me] = node_block
             if C > 1:
-                nright = _sender(engine, ((me + 1) % C) * L)
-                nleft = engine._data[((me - 1) % C) * L]
+                nright_rank = ((me + 1) % C) * L
+                nleft_rank = ((me - 1) % C) * L
+                nright = _sender(engine, nright_rank)
+                nleft = engine._data[nleft_rank]
                 for step in range(C - 1):
                     send_idx = (me - step) % C
                     recv_idx = (me - step - 1) % C
                     ticket = nright.send(nblocks[send_idx])
-                    nblocks[recv_idx] = _recv(nleft)
-                    nright.wait(ticket)
+                    nblocks[recv_idx] = _recv(nleft, dl, nleft_rank)
+                    _wait_send(nright, ticket, dl, nright_rank)
             full = b"".join(nblocks)
             # Phase 3: fan the full buffer out to the rest of the node
             # on their persistent senders (the seed spawned a thread per
             # peer per tensor here).
-            tickets = [(_sender(engine, r), _sender(engine, r).send(full))
+            tickets = [(r, _sender(engine, r), _sender(engine, r).send(full))
                        for r in local[1:]]
-            for s, ticket in tickets:
-                s.wait(ticket)
+            for r, s, ticket in tickets:
+                _wait_send(s, ticket, dl, r)
         else:
-            full = _recv(engine._data[local[0]])
+            full = _recv(engine._data[local[0]], dl, local[0])
 
         arr = np.frombuffer(full, dtype=dtype).copy()
         results.append(arr.reshape((sum(first_dims),) + rest_shape))
@@ -568,6 +651,7 @@ def _allgather_flat(engine, entries, resp: Response):
     member order)."""
     group, me = resp_group(engine, resp)
     size = len(group)
+    dl = _deadline(engine)
     results = []
     for e in entries:
         first_dims = resp.tensor_sizes
@@ -580,14 +664,16 @@ def _allgather_flat(engine, entries, resp: Response):
         blocks: List[Optional[np.ndarray]] = [None] * size
         blocks[me] = np.ascontiguousarray(e.array)
         if size > 1:
-            right = _sender(engine, group[(me + 1) % size])
-            left = engine._data[group[(me - 1) % size]]
+            right_rank = group[(me + 1) % size]
+            left_rank = group[(me - 1) % size]
+            right = _sender(engine, right_rank)
+            left = engine._data[left_rank]
             for step in range(size - 1):
                 send_idx = (me - step) % size
                 recv_idx = (me - step - 1) % size
                 ticket = right.send(blocks[send_idx])
-                payload = _recv(left)
-                right.wait(ticket)
+                payload = _recv(left, dl, left_rank)
+                _wait_send(right, ticket, dl, right_rank)
                 blk = np.frombuffer(payload, dtype=dtype)
                 blocks[recv_idx] = blk.reshape(
                     (first_dims[recv_idx],) + rest_shape)
@@ -610,6 +696,7 @@ def reducescatter(engine, entries, resp: Response):
     size = len(group)
     op = resp.reduce_op
     dtype = _np_dtype(resp.tensor_type)
+    dl = _deadline(engine)
     results = []
     for e in entries:
         arr = np.ascontiguousarray(e.array).astype(dtype, copy=False)
@@ -621,17 +708,20 @@ def reducescatter(engine, entries, resp: Response):
             continue
         chunks = [arr[bounds[i]:bounds[i + 1]].copy()
                   for i in range(size)]
-        right = _sender(engine, group[(me + 1) % size])
-        left = engine._data[group[(me - 1) % size]]
+        right_rank = group[(me + 1) % size]
+        left_rank = group[(me - 1) % size]
+        right = _sender(engine, right_rank)
+        left = engine._data[left_rank]
         # Virtual rank (me-1): the standard walk leaves member r owning
         # chunk (r+1)%size; shifting by one leaves it owning chunk r.
         for step in range(size - 1):
             send_idx = (me - 1 - step) % size
             recv_idx = (me - 2 - step) % size
             ticket = right.send(chunks[send_idx])
-            incoming = np.frombuffer(_recv(left), dtype=dtype).reshape(
+            incoming = np.frombuffer(
+                _recv(left, dl, left_rank), dtype=dtype).reshape(
                 (bounds[recv_idx + 1] - bounds[recv_idx],) + rest).copy()
-            right.wait(ticket)
+            _wait_send(right, ticket, dl, right_rank)
             chunks[recv_idx] = _combine(incoming, chunks[recv_idx], op)
         out = chunks[me]
         if op == ReduceOp.AVERAGE:
@@ -646,6 +736,7 @@ def reducescatter(engine, entries, resp: Response):
 def broadcast(engine, entries, resp: Response):
     group, _me = resp_group(engine, resp)
     rank = engine.rank
+    dl = _deadline(engine)
     results = []
     for e in entries:
         root = int(resp.tensor_sizes[0]) if resp.tensor_sizes \
@@ -655,13 +746,14 @@ def broadcast(engine, entries, resp: Response):
             continue
         if rank == root:
             payload = np.ascontiguousarray(e.array)
-            tickets = [(_sender(engine, r), _sender(engine, r).send(payload))
+            tickets = [(r, _sender(engine, r),
+                        _sender(engine, r).send(payload))
                        for r in group if r != root]
-            for s, ticket in tickets:
-                s.wait(ticket)
+            for r, s, ticket in tickets:
+                _wait_send(s, ticket, dl, r)
             results.append(e.array.copy())
         else:
-            payload = _recv(engine._data[root])
+            payload = _recv(engine._data[root], dl, root)
             arr = np.frombuffer(
                 payload, dtype=_np_dtype(resp.tensor_type)).copy()
             results.append(arr.reshape(e.array.shape))
@@ -673,6 +765,7 @@ def alltoall(engine, entries, resp: Response):
     # member list (parity with csrc Engine::DoAlltoall).
     group, rank = resp_group(engine, resp)
     size = len(group)
+    dl = _deadline(engine)
     results = []
     for e in entries:
         splits = e.splits
@@ -695,8 +788,8 @@ def alltoall(engine, entries, resp: Response):
             src = (rank - step) % size
             sender = _sender(engine, group[dst])
             ticket = sender.send(my_blocks[dst])
-            payload = _recv(engine._data[group[src]])
-            sender.wait(ticket)
+            payload = _recv(engine._data[group[src]], dl, group[src])
+            _wait_send(sender, ticket, dl, group[dst])
             blk = np.frombuffer(payload, dtype=dtype)
             if rest_shape:
                 blk = blk.reshape((-1,) + rest_shape)
@@ -713,4 +806,4 @@ def barrier(engine, resp: Response) -> None:
     # resp_group returns the full world for the global set.
     group, me = resp_group(engine, resp)
     _ring_allreduce_group(engine, np.zeros(1, np.int32), ReduceOp.SUM,
-                          group, me)
+                          group, me, _deadline(engine))

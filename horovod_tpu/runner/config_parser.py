@@ -21,6 +21,7 @@ _ARG_ENV = {
     "hierarchical_allgather": E.HIERARCHICAL_ALLGATHER,
     "ring_segment_bytes": E.RING_SEGMENT_BYTES,
     "sock_buf_bytes": E.SOCK_BUF_BYTES,
+    "collective_timeout": E.COLLECTIVE_TIMEOUT,
     "timeline_filename": E.TIMELINE,
     "timeline_mark_cycles": E.TIMELINE_MARK_CYCLES,
     "no_stall_check": E.STALL_CHECK_DISABLE,

@@ -226,6 +226,14 @@ def make_parser() -> argparse.ArgumentParser:
                       help="SO_SNDBUF/SO_RCVBUF for data-plane sockets "
                            "in bytes; 0 keeps the kernel default (see "
                            "docs/performance.md)")
+    tune.add_argument("--collective-timeout", type=float,
+                      dest="collective_timeout",
+                      help="seconds before an eager collective is "
+                           "declared hung: the gang agrees on the "
+                           "wedged rank(s) and aborts with a "
+                           "CollectiveTimeoutError instead of "
+                           "deadlocking; 0 (default) blocks forever "
+                           "(see docs/fault_tolerance.md)")
 
     auto = p.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", dest="autotune")
@@ -306,7 +314,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               "metrics-port + local_rank", file=sys.stderr)
         return 2
     for flag, val in (("--ring-segment-bytes", args.ring_segment_bytes),
-                      ("--sock-buf-bytes", args.sock_buf_bytes)):
+                      ("--sock-buf-bytes", args.sock_buf_bytes),
+                      ("--collective-timeout", args.collective_timeout)):
         if val is not None and val < 0:
             print(f"{_prog_name()}: {flag} must be >= 0 "
                   f"(got {val}; 0 disables)", file=sys.stderr)

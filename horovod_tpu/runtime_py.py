@@ -43,6 +43,7 @@ from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common import wire
 from horovod_tpu.common import response_cache as rcache
 from horovod_tpu.common.types import (
+    CollectiveTimeoutError,
     DataType,
     RanksFailedError,
     ReduceOp,
@@ -71,6 +72,31 @@ _OP_NAMES = {
     RequestType.BARRIER: "BARRIER",
     RequestType.REDUCESCATTER: "REDUCESCATTER",
 }
+
+# -- evict-and-replay retention ----------------------------------------
+# When the gang aborts an in-flight fused reduction (CollectiveTimeout-
+# Error), the survivors retain copies of the ORIGINAL inputs here —
+# pack() copies into the fusion buffer and the ring mutates only that
+# buffer, so entry.array is pristine at abort time.  The holder is
+# module-level on purpose: the elastic wrapper tears the engine down
+# and re-forms a new one, and the replay must survive that.
+_replay_lock = threading.Lock()
+_replay_batch: Optional[List[dict]] = None
+
+
+def retain_aborted_batch(batch: List[dict]) -> None:
+    global _replay_batch
+    with _replay_lock:
+        _replay_batch = batch
+
+
+def take_retained_batch() -> Optional[List[dict]]:
+    """Pop the retained aborted batch (None when nothing was aborted).
+    Each item: {name, array (copy), op, prescale, postscale}."""
+    global _replay_batch
+    with _replay_lock:
+        batch, _replay_batch = _replay_batch, None
+    return batch
 
 
 class HandleManager:
@@ -114,6 +140,10 @@ class HandleManager:
             status = self._status.pop(handle)
             result = self._result.pop(handle, None)
         if not status.ok_():
+            if status.exc is not None:
+                # Typed failure (e.g. CollectiveTimeoutError) — the
+                # elastic wrapper dispatches on the exception class.
+                raise status.exc
             raise RuntimeError(status.reason or "collective failed")
         return result
 
@@ -394,6 +424,37 @@ class PyEngine(_EngineBase):
         self._last_seen: Dict[int, float] = {}
         self._last_send = time.monotonic()
 
+        # Collective deadlines (docs/fault_tolerance.md "hung ranks vs
+        # dead ranks").  Default OFF (0) — identical hot path to the
+        # seed, pinned by tests/test_timeouts.py.  When on, every eager
+        # collective carries a deadline; a local hop timeout triggers
+        # the gang-wide abort agreement over the still-live control
+        # mesh (TAG_ABORT_REPORT / TAG_PROBE / TAG_PROBE_ACK /
+        # TAG_ABORT_VERDICT).
+        self.collective_timeout = env_util.collective_timeout_s()
+        self.collective_probe_timeout = env_util.get_float(
+            env_util.COLLECTIVE_PROBE_TIMEOUT,
+            max(0.5, self.collective_timeout / 2.0))
+        # Worker ctrl sends happen on the background thread (_worker_
+        # cycle) AND the recv thread (probe acks); serialize so frames
+        # never interleave.
+        self._ctrl_send_lock = threading.Lock()
+        # Coordinator: reports/acks captured by the ctrl recv threads.
+        self._abort_inbox: List[tuple] = []
+        self._abort_lock = threading.Lock()
+        # Worker: verdict handoff from the recv thread to the blocked
+        # background thread.
+        self._abort_verdict: Optional[tuple] = None
+        self._abort_cv = threading.Condition(self._abort_lock)
+        # Busy marker for probe acks: monotonic start of the collective
+        # currently executing on the background thread (0.0 = idle).
+        # Only maintained when the deadline knob is on.
+        self._in_collective_since = 0.0
+        self._in_collective_name = ""
+        # Coordinator: last ruled verdict, re-sent to stragglers whose
+        # own hop deadline fires after the broadcast.
+        self._last_verdict: Optional[tuple] = None
+
         # response cache (parity: response_cache.cc; protocol adapted to
         # the star controller — see common/response_cache.py docstring).
         # All cache state is touched only on the background thread.
@@ -472,6 +533,10 @@ class PyEngine(_EngineBase):
                 if tag == su.TAG_REQUEST_LIST:
                     with self._ctrl_lock:
                         self._ctrl_inbox.append((peer_rank, payload))
+                elif tag in (su.TAG_ABORT_REPORT, su.TAG_PROBE_ACK):
+                    with self._abort_lock:
+                        self._abort_inbox.append(
+                            (peer_rank, tag, payload))
         except (ConnectionError, OSError):
             # EOF/reset: fast liveness signal, stronger than a missed
             # heartbeat (only acted on when heartbeats are enabled).
@@ -485,6 +550,27 @@ class PyEngine(_EngineBase):
                     with self._response_cv:
                         self._response_inbox.append(payload)
                         self._response_cv.notify_all()
+                elif tag == su.TAG_PROBE:
+                    # Answer from THIS thread: the background thread may
+                    # be the very thing that is wedged in the data plane.
+                    since = self._in_collective_since
+                    busy_s = (time.monotonic() - since) if since else 0.0
+                    ack = wire.encode_probe_ack(
+                        since > 0.0, busy_s, self.epoch)
+                    try:
+                        with self._ctrl_send_lock:
+                            su.send_frame(self._ctrl_sock,
+                                          su.TAG_PROBE_ACK, ack)
+                    except (ConnectionError, OSError):
+                        pass
+                elif tag == su.TAG_ABORT_VERDICT:
+                    vname, vranks, vepoch = wire.decode_abort_verdict(
+                        payload)
+                    if vepoch != self.epoch:
+                        continue
+                    with self._abort_cv:
+                        self._abort_verdict = (vname, vranks)
+                        self._abort_cv.notify_all()
         except (ConnectionError, OSError):
             pass
 
@@ -839,7 +925,9 @@ class PyEngine(_EngineBase):
                                                epoch=self.epoch)
             try:
                 _fi.fire("ctrl.worker.send", str(self.rank))
-                su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST, payload)
+                with self._ctrl_send_lock:
+                    su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST,
+                                  payload)
                 self._last_send = time.monotonic()
             except (ConnectionError, OSError):
                 # The coordinator may have closed right after
@@ -852,7 +940,8 @@ class PyEngine(_EngineBase):
             # Idle past the heartbeat cadence: prove liveness.  A lost
             # coordinator surfaces through the recv loop, not here.
             try:
-                su.send_frame(self._ctrl_sock, su.TAG_HEARTBEAT, b"")
+                with self._ctrl_send_lock:
+                    su.send_frame(self._ctrl_sock, su.TAG_HEARTBEAT, b"")
             except (ConnectionError, OSError):
                 pass
             self._last_send = time.monotonic()
@@ -985,6 +1074,12 @@ class PyEngine(_EngineBase):
                 _absorb(req)
             for name, pos in peer_hits:
                 _absorb_hit(name, pos, peer)
+
+        # Hang detection: a worker's hop deadline fired while we are
+        # demonstrably healthy (running cycles) — rule on the abort now
+        # rather than waiting to block in the collective ourselves.
+        if self.collective_timeout > 0:
+            self._drain_abort_reports()
 
         # Liveness: evict ranks silent past the heartbeat timeout (or
         # whose ctrl connection dropped), reusing the Join readiness
@@ -1171,6 +1266,260 @@ class PyEngine(_EngineBase):
             self.timeline.instant(
                 timeline_mod.STRAGGLER, rank=lag_rank,
                 skew_ms=round(skew_s * 1e3, 3), tensor=name)
+
+    # -- collective-abort agreement (docs/fault_tolerance.md) ------------
+    #
+    # Heartbeats catch DEAD ranks; these four frames catch HUNG ones.
+    # A rank whose ring hop blows HVD_COLLECTIVE_TIMEOUT reports the
+    # suspect peer to the coordinator over the still-live control
+    # channel (TAG_ABORT_REPORT).  The coordinator probes the gang
+    # (TAG_PROBE / TAG_PROBE_ACK — answered from the recv thread, which
+    # stays responsive even while the background thread is wedged in
+    # the data plane), rules on who is actually stuck, and broadcasts
+    # TAG_ABORT_VERDICT so every survivor raises the SAME
+    # CollectiveTimeoutError for the SAME step.
+
+    def _drain_abort_reports(self) -> None:
+        """Coordinator, between cycles (i.e. not itself blocked in a
+        collective): act on hop-timeout reports that arrived while we
+        were healthy."""
+        with self._abort_lock:
+            if not self._abort_inbox:
+                return
+            inbox, self._abort_inbox = self._abort_inbox, []
+        reports: Dict[int, int] = {}
+        name = ""
+        for peer, tag, payload in inbox:
+            if tag != su.TAG_ABORT_REPORT:
+                continue  # stray ack from an already-finished probe round
+            nm, suspect, epoch = wire.decode_abort_report(payload)
+            if epoch != self.epoch:
+                continue
+            if self._last_verdict is not None and \
+                    self._last_verdict[0] == nm:
+                # Already ruled: this straggler's own hop deadline fired
+                # after the broadcast — re-send the verdict.
+                self._send_verdict_to(peer)
+                continue
+            reports[peer] = suspect
+            name = nm
+        if reports:
+            self._coordinate_abort(name, reports)
+
+    def _send_verdict_to(self, rank: int) -> None:
+        vname, vranks = self._last_verdict
+        sock = self._ctrl_socks.get(rank)
+        if sock is None:
+            return
+        try:
+            su.send_frame(
+                sock, su.TAG_ABORT_VERDICT,
+                wire.encode_abort_verdict(vname, vranks, self.epoch))
+        except (ConnectionError, OSError):
+            pass
+
+    def _coordinate_abort(self, name: str,
+                          reports: Dict[int, int]) -> List[int]:
+        """Probe the gang, rule on which rank(s) are wedged, broadcast
+        and apply the verdict.  Runs on the coordinator's background
+        thread — from _drain_abort_reports (coordinator healthy) or
+        from its own HopTimeout (coordinator was blocked in the stalled
+        collective too).  ``reports`` maps reporter rank -> the peer it
+        blamed.  Returns the agreed wedged ranks."""
+        t0 = time.monotonic()
+        self.log.error(
+            "collective %r blew its %gs deadline (reported by rank(s) "
+            "%s); probing the gang", name, self.collective_timeout,
+            sorted(reports))
+        live = [r for r in self._ctrl_socks
+                if r not in self._evicted_ranks]
+        acks: Dict[int, tuple] = {}
+
+        def _probe() -> None:
+            for r in live:
+                try:
+                    su.send_frame(self._ctrl_socks[r], su.TAG_PROBE, b"")
+                except (ConnectionError, OSError):
+                    pass
+
+        _probe()
+        deadline = t0 + max(0.1, self.collective_probe_timeout)
+        last_probe = t0
+        while time.monotonic() < deadline:
+            with self._abort_lock:
+                inbox, self._abort_inbox = self._abort_inbox, []
+            for peer, tag, payload in inbox:
+                if tag == su.TAG_PROBE_ACK:
+                    busy, busy_s, ep = wire.decode_probe_ack(payload)
+                    if ep == self.epoch:
+                        acks[peer] = (busy, busy_s)
+                elif tag == su.TAG_ABORT_REPORT:
+                    nm, suspect, ep = wire.decode_abort_report(payload)
+                    if ep == self.epoch:
+                        reports[peer] = suspect
+            # Converged: every live worker has either reported a timeout
+            # of its own (a victim of the hang, not its cause) or acked
+            # idle — nothing left to learn from the rest of the window.
+            if all(r in reports or (r in acks and not acks[r][0])
+                   for r in live):
+                break
+            now = time.monotonic()
+            if now - last_probe >= 0.25:
+                _probe()  # refresh busy durations
+                last_probe = now
+            time.sleep(0.02)
+
+        # Verdict: a live rank is wedged when it never reported a hop
+        # timeout of its own AND its last word was "busy" (or silence).
+        # Every healthy participant's own deadline fires within ~one
+        # collective timeout of the first, so by the window's end the
+        # busy-and-silent ranks are the truly stuck ones.
+        wedged = sorted(
+            r for r in live
+            if r not in reports and (r not in acks or acks[r][0]))
+        if not wedged:
+            # Nobody provably stuck (hang healed mid-probe, or the
+            # victim died and took its socket along): fall back on the
+            # most-blamed suspect, preferring non-reporters; ties go to
+            # the lowest rank so every coordinator incarnation would
+            # rule identically.
+            blame: Dict[int, int] = {}
+            for suspect in reports.values():
+                if suspect >= 0 and suspect not in reports:
+                    blame[suspect] = blame.get(suspect, 0) + 1
+            if not blame:
+                for suspect in reports.values():
+                    if suspect >= 0:
+                        blame[suspect] = blame.get(suspect, 0) + 1
+            if blame:
+                top = max(blame.values())
+                wedged = [min(r for r, n in blame.items() if n == top)]
+
+        payload = wire.encode_abort_verdict(name, wedged, self.epoch)
+        self._last_verdict = (name, wedged)
+        for r in live:
+            try:
+                su.send_frame(self._ctrl_socks[r],
+                              su.TAG_ABORT_VERDICT, payload)
+            except (ConnectionError, OSError):
+                pass
+        self._apply_abort_verdict(name, wedged, t0)
+        return wedged
+
+    def _report_and_await_verdict(self, name: str,
+                                  suspect: int) -> Optional[List[int]]:
+        """Worker half of the agreement: report the local hop timeout,
+        then block (on the background thread — the collective is dead
+        anyway) until the verdict lands.  None = no verdict in time,
+        i.e. the coordinator itself is wedged or lost."""
+        with self._abort_cv:
+            if self._abort_verdict is not None:
+                # Broadcast already arrived while this rank was still
+                # blocked in the data plane.
+                ranks = self._abort_verdict[1]
+                self._abort_verdict = None
+                return ranks
+        try:
+            with self._ctrl_send_lock:
+                su.send_frame(
+                    self._ctrl_sock, su.TAG_ABORT_REPORT,
+                    wire.encode_abort_report(name, suspect, self.epoch))
+        except (ConnectionError, OSError):
+            return None
+        # Budget: worst case the coordinator only starts probing after
+        # its OWN hop deadline (one collective timeout), then runs a
+        # full probe window.
+        deadline = time.monotonic() + max(
+            2.0 * self.collective_timeout,
+            self.collective_timeout + 2.0 * self.collective_probe_timeout)
+        with self._abort_cv:
+            while self._abort_verdict is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._abort_cv.wait(remaining)
+            ranks = self._abort_verdict[1]
+            self._abort_verdict = None
+        return ranks
+
+    def _apply_abort_verdict(self, name: str, ranks: List[int],
+                             t0: float) -> None:
+        """Record + apply an agreed abort: timeline record, metrics,
+        eviction state (so the next enqueue raises on every survivor
+        and the elastic wrapper re-forms without the wedged ranks)."""
+        elapsed = time.monotonic() - t0
+        _tmx.inc_counter("hvd_collective_timeouts_total")
+        _tmx.observe("hvd_collective_abort_seconds", elapsed)
+        if self.timeline.enabled:
+            self.timeline.instant(
+                timeline_mod.COLLECTIVE_ABORT, ranks=list(ranks),
+                tensor=name, abort_ms=round(elapsed * 1e3, 3))
+        self.log.error(
+            "gang verdict: rank(s) %s wedged during %r; aborting the "
+            "collective (%.0f ms after the local timeout)", ranks, name,
+            elapsed * 1e3)
+        self._evicted_ranks.update(ranks)
+        self._ranks_failed = sorted(set(self._ranks_failed) | set(ranks))
+        if self.rank == 0 and self._msg_table is not None:
+            # Same pruning as a heartbeat eviction, minus the liveness
+            # bookkeeping: drop the wedged ranks' pending requests so
+            # the post-abort cycles cannot hang on them.
+            self._joined_ranks.update(ranks)
+            for nm, lst in list(self._msg_table.entries.items()):
+                lst[:] = [q for q in lst
+                          if q.request_rank not in self._evicted_ranks]
+                if not lst:
+                    self._msg_table.pop(nm)
+                    self._hit_ranks.pop(nm, None)
+
+    def _retain_for_replay(self, resp: Response,
+                           entries: List[TensorTableEntry]) -> None:
+        """Keep copies of the aborted fused reduction's ORIGINAL inputs
+        (pack() copies; the ring never mutates entry.array) so the
+        re-formed gang can replay the batch."""
+        if resp.response_type != ResponseType.ALLREDUCE:
+            return
+        batch = [
+            {"name": e.name, "array": np.array(e.array, copy=True),
+             "op": resp.reduce_op, "prescale": resp.prescale_factor,
+             "postscale": resp.postscale_factor}
+            for e in entries if e.handle >= 0]
+        if batch:
+            retain_aborted_batch(batch)
+
+    def _collective_abort(self, resp: Response,
+                          entries: List[TensorTableEntry],
+                          hop: Exception) -> Status:
+        """A local hop deadline fired: run the gang-wide agreement and
+        build the typed failure status every survivor shares."""
+        name = resp.tensor_names[0]
+        suspect = int(getattr(hop, "peer", -1))
+        if self.rank == 0:
+            wedged = self._coordinate_abort(name, {0: suspect})
+        else:
+            t0 = time.monotonic()
+            wedged = self._report_and_await_verdict(name, suspect)
+            if wedged is None:
+                # The one rank that could rule never did: treat it like
+                # a lost coordinator so the elastic wrapper re-forms
+                # around rank 0.
+                reason = ("collective timed out and no abort verdict "
+                          "arrived: coordinator wedged or lost")
+                self._abort(reason)
+                return Status.aborted(reason)
+            if self.rank in wedged:
+                # The gang ruled *us* wedged (e.g. our probe acks never
+                # made it out): the group has moved on without this
+                # rank — stop before desyncing it.
+                raise RuntimeError(
+                    "evicted by the coordinator (collective timeout)")
+            self._apply_abort_verdict(name, wedged, t0)
+        self._retain_for_replay(resp, entries)
+        err = CollectiveTimeoutError(wedged, name,
+                                     self.collective_timeout)
+        status = Status.aborted(str(err))
+        status.exc = err
+        return status
 
     def _check_stalls(self) -> bool:
         now = time.monotonic()
@@ -1452,6 +1801,13 @@ class PyEngine(_EngineBase):
         entries = self._get_entries(resp)
         op_name = resp.response_type.name
         self.timeline.start(resp.tensor_names[0], op_name)
+        deadline_on = self.collective_timeout > 0
+        if deadline_on:
+            # Busy marker for probe acks: the recv thread reads it to
+            # tell the coordinator we are inside a collective (and for
+            # how long) even while this thread is blocked in the ring.
+            self._in_collective_name = resp.tensor_names[0]
+            self._in_collective_since = time.monotonic()
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
                 results = cpu_backend.allreduce(self, entries, resp)
@@ -1469,10 +1825,23 @@ class PyEngine(_EngineBase):
             else:
                 raise RuntimeError(f"bad response type {resp.response_type}")
             status = Status.ok()
+        except cpu_backend.HopTimeout as e:
+            results = [None] * len(entries)
+            if deadline_on:
+                self._in_collective_since = 0.0
+                status = self._collective_abort(resp, entries, e)
+            else:
+                # The always-on send-wait backstop tripped with the
+                # deadline knob off: surface it like any other
+                # data-plane failure (no abort agreement to run).
+                self.log.error("collective %s failed: %r", op_name, e)
+                status = Status.unknown_error(str(e))
         except Exception as e:
             self.log.error("collective %s failed: %r", op_name, e)
             results = [None] * len(entries)
             status = Status.unknown_error(str(e))
+        if deadline_on:
+            self._in_collective_since = 0.0
         self.timeline.end(resp.tensor_names[0])
         for e, res in zip(entries, results):
             self._release_name(e.name)

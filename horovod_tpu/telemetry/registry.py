@@ -107,6 +107,12 @@ KNOWN_METRICS: Dict[str, dict] = {
         "Ranks declared dead by the heartbeat timeout."),
     "hvd_evictions_total": _counter(
         "Dead ranks evicted via the Join machinery."),
+    "hvd_collective_timeouts_total": _counter(
+        "Collectives aborted by the gang after blowing "
+        "HVD_COLLECTIVE_TIMEOUT (hung-rank detection)."),
+    "hvd_collective_abort_seconds": _hist(
+        "Latency from a rank's local hop timeout to the applied "
+        "gang-wide abort verdict.", *_SECONDS),
     "hvd_kv_retries_total": _counter(
         "Rendezvous KV client request retries."),
     "hvd_elastic_epoch": _gauge(

@@ -38,6 +38,19 @@ SOCK_BUF_BYTES = "HVD_SOCK_BUF_BYTES"
 # HOROVOD_HEARTBEAT_TIMEOUT is accepted as an alias of the HVD_ name.
 HEARTBEAT_TIMEOUT = "HVD_HEARTBEAT_TIMEOUT"
 HEARTBEAT_INTERVAL = "HVD_HEARTBEAT_INTERVAL"
+# Collective deadlines (PyEngine data plane; docs/fault_tolerance.md).
+# COLLECTIVE_TIMEOUT (seconds, 0 = off = block forever like the seed)
+# bounds every eager collective: ring hops get per-phase socket
+# deadlines, a local timeout is reported to the coordinator, and the
+# gang agrees on a CollectiveTimeoutError naming the wedged rank(s).
+# COLLECTIVE_PROBE_TIMEOUT is how long the coordinator's probe round
+# waits for acks before ruling (default: half the collective timeout).
+# SEND_WAIT_CAP_S is an always-on generous hard cap on PeerSender.wait
+# so a dead sender thread can never hang a hop silently, even with the
+# collective timeout off.
+COLLECTIVE_TIMEOUT = "HVD_COLLECTIVE_TIMEOUT"
+COLLECTIVE_PROBE_TIMEOUT = "HVD_COLLECTIVE_PROBE_TIMEOUT"
+SEND_WAIT_CAP_S = "HVD_SEND_WAIT_CAP_S"
 # Rendezvous KV client retry policy.
 KV_RETRIES = "HVD_KV_RETRIES"
 KV_TIMEOUT = "HVD_KV_TIMEOUT"
@@ -117,3 +130,17 @@ def cycle_time_ms() -> float:
 def ring_segment_bytes() -> int:
     """Ring-hop segment size; 0 (default) disables segmentation."""
     return max(0, get_int(RING_SEGMENT_BYTES, 0))
+
+
+def collective_timeout_s() -> float:
+    """Per-collective deadline in seconds; 0 (default) = no deadline,
+    the seed's block-forever behavior."""
+    return max(0.0, get_float(COLLECTIVE_TIMEOUT, 0.0))
+
+
+def send_wait_cap_s() -> float:
+    """Hard cap on any single PeerSender.wait, always on (a dead sender
+    thread must never hang a hop silently).  Generous by design: it is
+    a backstop, not a tunable deadline — use HVD_COLLECTIVE_TIMEOUT for
+    bounded-time collectives."""
+    return get_float(SEND_WAIT_CAP_S, 300.0)

@@ -24,6 +24,7 @@ import collections
 import socket
 import struct
 import threading
+import time
 from typing import Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
@@ -36,6 +37,14 @@ TAG_RESPONSE_LIST = 2
 TAG_DATA = 3
 TAG_KV = 4
 TAG_HEARTBEAT = 5
+# Collective-abort agreement (Python engine only, like TAG_HEARTBEAT:
+# csrc/sockets.h stops at kTagData, and the native engine never
+# negotiates HVD_COLLECTIVE_TIMEOUT).  Payload codecs: common/wire.py;
+# protocol: docs/fault_tolerance.md "hung ranks vs dead ranks".
+TAG_ABORT_REPORT = 6    # worker -> coordinator: local hop timeout
+TAG_PROBE = 7           # coordinator -> workers: are you wedged?
+TAG_PROBE_ACK = 8       # worker -> coordinator: busy flag + duration
+TAG_ABORT_VERDICT = 9   # coordinator -> workers: agreed wedged ranks
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:
@@ -96,7 +105,8 @@ def send_frame_zc(sock: socket.socket, tag: int, payload) -> None:
         return
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
+def recv_exact(sock: socket.socket, n: int,
+               deadline: Optional[float] = None) -> bytes:
     """Receive exactly ``n`` bytes as a new ``bytes`` object.
 
     Implemented over one preallocated ``bytearray`` + ``recv_into`` — no
@@ -105,53 +115,85 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     once per call, as before, so tests/test_chaos.py semantics hold.
     """
     buf = bytearray(n)
-    recv_exact_into(sock, memoryview(buf))
+    recv_exact_into(sock, memoryview(buf), deadline)
     return bytes(buf)
 
 
-def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+def recv_exact_into(sock: socket.socket, view: memoryview,
+                    deadline: Optional[float] = None) -> None:
     """Fill ``view`` completely from the socket via ``recv_into``.
 
     The caller owns the buffer; nothing is allocated here.  Fires the
     ``sock.recv`` fault-injection site once (same contract as
     :func:`recv_exact`).
+
+    ``deadline`` is an absolute ``time.monotonic()`` timestamp; when
+    set, every ``recv_into`` runs under ``settimeout(remaining)`` and a
+    :class:`TimeoutError` is raised once the deadline passes.  When
+    ``None`` (the default) the code path is byte-identical to before:
+    no clock reads, no ``settimeout`` calls, block forever.
     """
     _fi.fire("sock.recv")
     got = 0
     n = len(view)
-    while got < n:
-        r = sock.recv_into(view[got:], min(n - got, 1 << 20))
-        if not r:
-            raise ConnectionError("peer closed connection")
-        got += r
+    if deadline is None:
+        while got < n:
+            r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+            if not r:
+                raise ConnectionError("peer closed connection")
+            got += r
+        return
+    try:
+        while got < n:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("receive deadline exceeded")
+            sock.settimeout(remaining)
+            try:
+                r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+            except socket.timeout:  # alias of TimeoutError on >=3.10
+                raise TimeoutError("receive deadline exceeded") from None
+            if not r:
+                raise ConnectionError("peer closed connection")
+            got += r
+    finally:
+        # Restore blocking mode; on the timeout path the socket is
+        # poisoned (mid-frame) and the caller tears it down anyway.
+        try:
+            sock.settimeout(None)
+        except OSError:
+            pass
 
 
-def recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
-    hdr = recv_exact(sock, HEADER.size)
+def recv_frame(sock: socket.socket,
+               deadline: Optional[float] = None) -> Tuple[int, bytes]:
+    hdr = recv_exact(sock, HEADER.size, deadline)
     tag, n = HEADER.unpack(hdr)
-    return tag, recv_exact(sock, n)
+    return tag, recv_exact(sock, n, deadline)
 
 
-def recv_frame_into(sock: socket.socket, view: memoryview) -> Tuple[int, int]:
+def recv_frame_into(sock: socket.socket, view: memoryview,
+                    deadline: Optional[float] = None) -> Tuple[int, int]:
     """Receive one frame's payload straight into ``view`` (which must be
     at least the frame's length); returns ``(tag, nbytes)``."""
-    hdr = recv_exact(sock, HEADER.size)
+    hdr = recv_exact(sock, HEADER.size, deadline)
     tag, n = HEADER.unpack(hdr)
     if n > len(view):
         raise ValueError(
             f"frame payload of {n} bytes exceeds the receive buffer "
             f"({len(view)} bytes)")
-    recv_exact_into(sock, view[:n])
+    recv_exact_into(sock, view[:n], deadline)
     return tag, n
 
 
-def recv_frame_header(sock: socket.socket) -> Tuple[int, int]:
+def recv_frame_header(sock: socket.socket,
+                      deadline: Optional[float] = None) -> Tuple[int, int]:
     """Read just the frame header: ``(tag, payload_len)``.  The caller
     then drains exactly ``payload_len`` bytes with
     :func:`recv_exact_into` — in one gulp or in segments (the segmented
     ring reads a hop in ``HVD_RING_SEGMENT_BYTES`` slices so each
     slice's reduction overlaps the next slice's receive)."""
-    hdr = recv_exact(sock, HEADER.size)
+    hdr = recv_exact(sock, HEADER.size, deadline)
     return HEADER.unpack(hdr)
 
 
@@ -224,10 +266,22 @@ class PeerSender:
 
     def wait(self, seq: int, timeout: Optional[float] = None) -> None:
         """Block until ticket ``seq`` has hit the kernel (or raise the
-        send error that stopped the thread)."""
+        send error that stopped the thread).
+
+        ``timeout`` bounds the *total* wait: remaining time is
+        recomputed across spurious/partial wakeups, so the call returns
+        (or raises :class:`TimeoutError`) within ``timeout`` seconds of
+        entry, not per condition-variable wait."""
+        deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while self._done_seq < seq and self._exc is None:
-                if not self._cv.wait(timeout):
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            "send did not complete in time")
+                if not self._cv.wait(remaining):
                     raise TimeoutError("send did not complete in time")
             if self._exc is not None and self._fail_seq is not None \
                     and seq >= self._fail_seq:
@@ -258,6 +312,10 @@ class PeerSender:
                 seq, tag, payload = self._deque.popleft()
             try:
                 if self._exc is None:
+                    # Half-open fault site: a peer whose outbound path
+                    # silently blackholes (kind "halfopen" blocks here,
+                    # then surfaces as a ConnectionError at wait()).
+                    _fi.fire("sock.halfopen", str(tag))
                     send_frame_zc(self._sock, tag, payload)
             except BaseException as e:  # surface at wait()
                 with self._cv:
@@ -286,8 +344,6 @@ def connect_retry(host: str, port: int, timeout: float = 30.0,
     backoff + jitter between attempts (``interval`` seeds the backoff
     base) so a gang of workers dialing one listener does not retry in
     lockstep."""
-    import time
-
     from horovod_tpu.common.retry import backoff_delays
 
     deadline = time.monotonic() + timeout
@@ -295,10 +351,17 @@ def connect_retry(host: str, port: int, timeout: float = 30.0,
         attempts=64, base_delay=interval, max_delay=1.0, jitter=0.5,
         seed=port))
     last: Optional[OSError] = None
-    while time.monotonic() < deadline:
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
         try:
             _fi.fire("sock.connect", f"{host}:{port}")
-            s = socket.create_connection((host, port), timeout=5.0)
+            # Per-attempt dial timeout: the 5 s cap, shrunk to whatever
+            # is left on the overall deadline near expiry — a negative
+            # or zero timeout must never reach create_connection.
+            s = socket.create_connection(
+                (host, port), timeout=min(5.0, remaining))
             configure_data_socket(s)
             s.settimeout(None)
             return s

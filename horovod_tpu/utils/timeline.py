@@ -42,6 +42,11 @@ NONFINITE_SKIP = "NONFINITE_SKIP"
 DIVERGENCE_DETECTED = "DIVERGENCE_DETECTED"
 CKPT_VERIFY_FAIL = "CKPT_VERIFY_FAIL"
 
+# Collective-deadline record (runtime_py._apply_abort_verdict): the gang
+# agreed a collective blew HVD_COLLECTIVE_TIMEOUT and named the wedged
+# rank(s).
+COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
+
 # Telemetry records (horovod_tpu.telemetry; docs/metrics.md).
 STRAGGLER = "STRAGGLER"
 

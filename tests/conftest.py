@@ -12,6 +12,8 @@ wraps each pytest file in ``horovodrun -np 2 -H localhost:2``.
 """
 
 import os
+import signal
+import threading
 
 # Hard assignment, not setdefault: the outer environment may export
 # JAX_PLATFORMS=axon (TPU tunnel), and tests must run on the virtual CPU
@@ -121,6 +123,43 @@ def pytest_collection_modifyitems(config, items):
         if engine in ("py", "mixed") and not any(
                 f"::{k}[" in item.nodeid for k in _ENGINE_MATRIX_KEEP):
             item.add_marker(skip)
+
+
+# -- per-test hard wall (pytest-timeout-style, stdlib-only) -------------
+# Multiprocess gang tests deadlock by definition when the machinery under
+# test fails: a SIGALRM wall turns "CI hangs until the runner's global
+# timeout" into an ordinary test failure with a traceback pointing at the
+# blocked line.  Opt in with @pytest.mark.timeout(seconds).  SIGALRM only
+# interrupts the main thread, which is exactly where a hung gang test
+# blocks (subprocess .wait / thread .join).
+
+
+class HardWallTimeout(Exception):
+    """A @pytest.mark.timeout(N) wall expired — almost always a hung
+    gang rather than a slow one."""
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    seconds = float(marker.args[0]) if marker and marker.args else 0.0
+    if seconds <= 0 or not hasattr(signal, "SIGALRM") or \
+            threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        raise HardWallTimeout(
+            f"{item.nodeid} exceeded its {seconds:g}s hard wall "
+            "(hung gang?)")
+
+    old_handler = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0.0)
+        signal.signal(signal.SIGALRM, old_handler)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,584 @@
+"""Collective deadlines: hang detection, gang-wide abort agreement, and
+evict-and-replay recovery (docs/fault_tolerance.md, "hung ranks vs dead
+ranks").
+
+Layered like the subsystem itself:
+
+* socketutil unit tests — deadline receive math, ``PeerSender.wait``
+  timeouts, ``connect_retry`` near-expiry, mid-header peer death.
+* knob-off pins — with ``HVD_COLLECTIVE_TIMEOUT`` unset the hot path is
+  byte-identical to the seed: no clock reads, no ``settimeout`` calls.
+  (The rest of the tier-1 suite — dataplane, chaos, elastic — runs with
+  the knob unset, so it doubles as the full behavior pin.)
+* wire codecs — abort report / probe ack / verdict roundtrips.
+* the acceptance gang — a chaos-injected ``sock.stall`` wedges one rank
+  mid-fused-reduction; every survivor must raise the same
+  ``CollectiveTimeoutError`` naming the wedged rank within 2x the
+  timeout, rank 0's timeline must record ``COLLECTIVE_ABORT``, and the
+  elastic wrapper must re-form without the victim and replay the
+  aborted fused batch bit-identically to the survivors' fused oracle.
+"""
+
+import json
+import os
+import re
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.common.types import (
+    CollectiveTimeoutError,
+    RanksFailedError,
+    Status,
+)
+from horovod_tpu.ops import cpu_backend
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import socketutil as su
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "timeout_worker.py")
+
+TIMEOUT_S = 2.0  # HVD_COLLECTIVE_TIMEOUT for the gang scenario
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# typed error + status plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_collective_timeout_error_type():
+    e = CollectiveTimeoutError([2, 0], "allreduce.grad", 3.0)
+    assert isinstance(e, RanksFailedError)  # elastic catches it as one
+    assert e.ranks == [0, 2]                # sorted, like the parent
+    assert e.tensor_name == "allreduce.grad"
+    assert e.timeout_s == 3.0
+    assert "timed out" in str(e) and "0, 2" in str(e)
+
+
+def test_handle_manager_raises_typed_status_exc():
+    from horovod_tpu.runtime_py import HandleManager
+
+    hm = HandleManager()
+    h = hm.allocate()
+    err = CollectiveTimeoutError([1], "t", 1.0)
+    st = Status.aborted(str(err))
+    st.exc = err
+    hm.mark_done(h, st)
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        hm.wait(h)
+    assert ei.value is err
+    # Untyped failures keep the old RuntimeError surface.
+    h2 = hm.allocate()
+    hm.mark_done(h2, Status.aborted("plain failure"))
+    with pytest.raises(RuntimeError, match="plain failure"):
+        hm.wait(h2)
+
+
+# ---------------------------------------------------------------------------
+# socketutil: deadline receive
+# ---------------------------------------------------------------------------
+
+
+def test_recv_exact_deadline_expires():
+    a, b = socket.socketpair()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="deadline"):
+            su.recv_exact(a, 4, deadline=time.monotonic() + 0.15)
+        dt = time.monotonic() - t0
+        assert 0.1 <= dt < 2.0, dt
+        # The socket is restored to blocking mode for the teardown path.
+        assert a.gettimeout() is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_deadline_data_in_time():
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"abcd")
+        assert su.recv_exact(a, 4,
+                             deadline=time.monotonic() + 5.0) == b"abcd"
+        assert a.gettimeout() is None  # blocking mode restored
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_partial_then_stall_times_out():
+    """Half a payload followed by silence — the remaining-time math must
+    keep shrinking across recv calls and still raise at the deadline."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"ab")
+        with pytest.raises(TimeoutError, match="deadline"):
+            su.recv_exact(a, 4, deadline=time.monotonic() + 0.15)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_frame_header_peer_closed_mid_header():
+    """A peer dying mid-header is a ConnectionError (dead rank), never a
+    short read misparsed as a frame."""
+    a, b = socket.socketpair()
+    try:
+        b.sendall(su.HEADER.pack(su.TAG_DATA, 12)[:3])  # 3 of 8 bytes
+        b.close()
+        with pytest.raises(ConnectionError, match="peer closed"):
+            su.recv_frame_header(a)
+    finally:
+        a.close()
+
+
+class _SpySock:
+    """Socket wrapper counting ``settimeout`` calls (the knob-off pin:
+    the deadline-free path must never touch socket timeout state)."""
+
+    def __init__(self, sock):
+        self._sock = sock
+        self.settimeout_calls = []
+
+    def recv_into(self, *a, **kw):
+        return self._sock.recv_into(*a, **kw)
+
+    def settimeout(self, t):
+        self.settimeout_calls.append(t)
+        self._sock.settimeout(t)
+
+
+def test_recv_knob_off_path_never_touches_socket_timeout():
+    a, b = socket.socketpair()
+    try:
+        b.sendall(b"abcdefgh")
+        spy = _SpySock(a)
+        assert su.recv_exact(spy, 8) == b"abcdefgh"  # deadline=None
+        assert spy.settimeout_calls == []
+        # With a deadline the same call uses settimeout and restores
+        # blocking mode (None) last.
+        b.sendall(b"abcdefgh")
+        assert su.recv_exact(spy, 8,
+                             deadline=time.monotonic() + 5.0) == b"abcdefgh"
+        assert spy.settimeout_calls and spy.settimeout_calls[-1] is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_into_knob_off_allocates_nothing():
+    """Tracemalloc pin (same contract as the chaos harness's fire()):
+    the deadline-free receive path allocates nothing — no deadline
+    arithmetic objects, no settimeout bookkeeping."""
+    import gc
+    import tracemalloc
+
+    a, b = socket.socketpair()
+    try:
+        payload = b"x" * 64
+        buf = bytearray(64)
+        view = memoryview(buf)
+        b.sendall(payload)
+        su.recv_exact_into(a, view)  # warmup
+        gc.collect()
+        tracemalloc.start()
+        before = tracemalloc.get_traced_memory()[0]
+        for _ in range(100):
+            b.sendall(payload)
+            su.recv_exact_into(a, view)
+        after = tracemalloc.get_traced_memory()[0]
+        tracemalloc.stop()
+        assert after - before < 512, (before, after)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# socketutil: PeerSender.wait timeout
+# ---------------------------------------------------------------------------
+
+
+def test_peersender_wait_times_out_on_stuck_ticket():
+    a, b = socket.socketpair()
+    ps = su.PeerSender(a, name="hvd-send-test")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError,
+                           match="send did not complete in time"):
+            ps.wait(1, timeout=0.15)  # ticket never even enqueued
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        ps.close(timeout=2.0)
+        a.close()
+        b.close()
+
+
+def test_peersender_wait_times_out_on_blocked_kernel_send():
+    """A payload far beyond the socketpair buffer with a peer that never
+    reads: the sender thread blocks in the kernel, and wait() must
+    return TimeoutError instead of hanging the hop."""
+    a, b = socket.socketpair()
+    ps = su.PeerSender(a, name="hvd-send-test")
+    try:
+        ticket = ps.send(b"\x00" * (64 << 20))
+        with pytest.raises(TimeoutError,
+                           match="send did not complete in time"):
+            ps.wait(ticket, timeout=0.2)
+    finally:
+        # Unblock the stuck sendall so close() can join the thread.
+        a.close()
+        b.close()
+        ps.close(timeout=5.0)
+
+
+def test_wait_send_wraps_timeout_as_hop_timeout():
+    a, b = socket.socketpair()
+    ps = su.PeerSender(a, name="hvd-send-test")
+    try:
+        ps.send(b"\x00" * (64 << 20))
+        with pytest.raises(cpu_backend.HopTimeout) as ei:
+            cpu_backend._wait_send(ps, 1, time.monotonic() + 0.2, peer=3)
+        assert ei.value.peer == 3 and ei.value.phase == "send"
+    finally:
+        a.close()
+        b.close()
+        ps.close(timeout=5.0)
+
+
+def test_wait_send_knob_off_uses_generous_cap(monkeypatch):
+    """With no collective deadline the backstop cap still applies — a
+    dead sender thread must never hang a hop silently."""
+    monkeypatch.setenv(env_util.SEND_WAIT_CAP_S, "0.15")
+    a, b = socket.socketpair()
+    ps = su.PeerSender(a, name="hvd-send-test")
+    try:
+        ps.send(b"\x00" * (64 << 20))
+        t0 = time.monotonic()
+        with pytest.raises(cpu_backend.HopTimeout):
+            cpu_backend._wait_send(ps, 1, None, peer=1)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        a.close()
+        b.close()
+        ps.close(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# socketutil: connect_retry near expiry
+# ---------------------------------------------------------------------------
+
+
+def test_connect_retry_never_passes_nonpositive_timeout(monkeypatch):
+    """Near the overall deadline the per-attempt dial timeout shrinks to
+    the remaining budget — and must never reach create_connection as a
+    zero/negative value (socket raises ValueError on those)."""
+    seen = []
+
+    def fake_create_connection(addr, timeout=None):
+        seen.append(timeout)
+        raise ConnectionRefusedError("nope")
+
+    monkeypatch.setattr(su.socket, "create_connection",
+                        fake_create_connection)
+    with pytest.raises(ConnectionError, match="cannot connect"):
+        su.connect_retry("127.0.0.1", 1, timeout=0.3, interval=0.01)
+    assert seen, "no dial attempts were made"
+    assert all(t is not None and 0 < t <= 5.0 for t in seen), seen
+
+
+def test_connect_retry_sleep_never_overshoots_deadline(monkeypatch):
+    """The inter-attempt backoff is clamped to the remaining budget, so
+    the call returns close to its deadline, not a full backoff late."""
+
+    def refuse(addr, timeout=None):
+        raise ConnectionRefusedError("nope")
+
+    monkeypatch.setattr(su.socket, "create_connection", refuse)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        su.connect_retry("127.0.0.1", 1, timeout=0.25, interval=0.2)
+    assert time.monotonic() - t0 < 1.5
+
+
+# ---------------------------------------------------------------------------
+# engine-side helpers: deadlines off by default
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_helper_defaults_off():
+    assert cpu_backend._deadline(object()) is None  # bare test engines
+
+    class Eng:
+        collective_timeout = 0.0
+
+    assert cpu_backend._deadline(Eng()) is None
+
+    Eng.collective_timeout = 1.5
+    d = cpu_backend._deadline(Eng())
+    assert d is not None
+    assert 1.0 < d - time.monotonic() <= 1.5 + 0.1
+
+
+def test_hop_timeout_carries_peer_and_phase():
+    e = cpu_backend.HopTimeout(2, "recv")
+    assert isinstance(e, TimeoutError)
+    assert e.peer == 2 and e.phase == "recv"
+    assert "rank 2" in str(e)
+
+
+def test_env_knob_defaults(monkeypatch):
+    monkeypatch.delenv(env_util.COLLECTIVE_TIMEOUT, raising=False)
+    monkeypatch.delenv(env_util.SEND_WAIT_CAP_S, raising=False)
+    assert env_util.collective_timeout_s() == 0.0  # off = seed behavior
+    assert env_util.send_wait_cap_s() == 300.0
+    monkeypatch.setenv(env_util.COLLECTIVE_TIMEOUT, "2.5")
+    assert env_util.collective_timeout_s() == 2.5
+    monkeypatch.setenv(env_util.COLLECTIVE_TIMEOUT, "-3")
+    assert env_util.collective_timeout_s() == 0.0  # clamped, not armed
+
+
+# ---------------------------------------------------------------------------
+# wire codecs + fault kinds
+# ---------------------------------------------------------------------------
+
+
+def test_wire_abort_codecs_roundtrip():
+    from horovod_tpu.common import wire
+
+    blob = wire.encode_abort_report("allreduce.grad", 2, epoch=7)
+    assert wire.decode_abort_report(blob) == ("allreduce.grad", 2, 7)
+    blob = wire.encode_abort_report("x", -1)  # unknown suspect
+    assert wire.decode_abort_report(blob) == ("x", -1, 0)
+
+    blob = wire.encode_probe_ack(True, 3.25, epoch=1)
+    busy, busy_s, epoch = wire.decode_probe_ack(blob)
+    assert busy is True and busy_s == 3.25 and epoch == 1
+
+    blob = wire.encode_abort_verdict("t", [3, 1], epoch=2)
+    assert wire.decode_abort_verdict(blob) == ("t", [1, 3], 2)
+    blob = wire.encode_abort_verdict("t", [])  # empty verdict is legal
+    assert wire.decode_abort_verdict(blob) == ("t", [], 0)
+
+
+def test_stall_fault_sleeps_then_continues():
+    fi.configure({"faults": [
+        {"site": "s", "kind": "stall", "stall_s": 0.1, "times": 1}]})
+    t0 = time.monotonic()
+    fi.fire("s")  # no raise: the hang heals
+    assert time.monotonic() - t0 >= 0.08
+    fi.fire("s")  # budget spent: clean
+
+
+def test_halfopen_fault_stalls_then_errors():
+    fi.configure({"faults": [
+        {"site": "s", "kind": "halfopen", "stall_s": 0.1}]})
+    t0 = time.monotonic()
+    with pytest.raises(fi.InjectedFault, match="halfopen"):
+        fi.fire("s")
+    assert time.monotonic() - t0 >= 0.08
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gang: stall -> agree -> abort -> evict -> replay
+# ---------------------------------------------------------------------------
+
+
+def _grad(rank, step, j, n=8):
+    # Mirror of timeout_worker.grad — the oracle inputs.
+    return (np.arange(n, dtype=np.float32) * (j + 1)
+            + 10.0 * rank + 100.0 * step).astype(np.float32)
+
+
+def _parse_cte(out):
+    m = re.search(r"CTE ranks=(\[[^\]]*\]) tensor=(\S+) dt=([\d.]+)", out)
+    return (json.loads(m.group(1)), m.group(2), float(m.group(3))) \
+        if m else None
+
+
+def _parse_replays(out):
+    return {m.group(1): m.group(2)
+            for m in re.finditer(r"REPLAY (\S+) ([0-9a-f]+)", out)}
+
+
+def _steps(out):
+    return [(int(m.group(1)), float(m.group(2)))
+            for m in re.finditer(r"STEP (\d+) ([\d.]+)", out)]
+
+
+@pytest.mark.timeout(150)
+def test_stalled_rank_gang_abort_evict_replay(tmp_path):
+    """One rank of three wedges mid-fused-reduction (``sock.stall``).
+    Without the deadline subsystem this gang deadlocks forever — the
+    victim is alive, nothing errors, heartbeats can't see it (the
+    background thread doing heartbeats IS the wedged one).  With
+    ``HVD_COLLECTIVE_TIMEOUT=2``:
+
+    * both survivors raise ``CollectiveTimeoutError`` naming rank 2 —
+      and only rank 2, even though the blocked ring makes each survivor
+      *look* wedged to its neighbor — within 2x the timeout,
+    * rank 0's timeline records ``COLLECTIVE_ABORT``,
+    * the elastic wrapper re-forms a 2-rank gang and replays the aborted
+      fused batch from its retained inputs, bit-identical to the fused
+      oracle over the survivors' original step-1 arrays,
+    * training resumes over the survivor gang and completes.
+    """
+    np_, victim = 3, 2
+    tl_path = tmp_path / "timeout_timeline.json"
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.pop(fi.ENV_VAR, None)
+            env["PYTHONPATH"] = (REPO + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_CROSS_RANK": "0",
+                "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_CORE": "py",
+                "HVD_ELASTIC_EPOCH": "0",
+                "HVD_ELASTIC_MIN_NP": "2",
+                "HVD_ELASTIC_MAX_NP": str(np_),
+                "HVD_ELASTIC_UID": f"uid-{rank}",
+                "HVD_ELASTIC_CHECK_INTERVAL_S": "0.05",
+                "HVD_COLLECTIVE_TIMEOUT": str(TIMEOUT_S),
+                "HVD_COLLECTIVE_PROBE_TIMEOUT": "0.5",
+            })
+            if rank == victim:
+                env["TIMEOUT_VICTIM"] = "1"
+            if rank == 0:
+                env["HVD_TIMELINE"] = str(tl_path)
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+        # Survivors finish on their own; the victim is wedged in a 600 s
+        # injected stall by design — collect the survivors first, then
+        # put the victim down (a real operator's SIGKILL).
+        outs = {}
+        deadline = time.monotonic() + 120.0
+        for rank in range(np_ - 1):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = procs[rank].communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"survivor rank {rank} hung: the gang-wide abort "
+                    "never released it")
+            outs[rank] = (procs[rank].returncode, out.decode(),
+                          err.decode())
+        assert procs[victim].poll() is None, \
+            "the victim exited on its own — the stall never wedged it"
+        procs[victim].kill()
+        v_out, v_err = procs[victim].communicate(timeout=30)
+        outs[victim] = (procs[victim].returncode, v_out.decode(),
+                        v_err.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    # -- the victim: wedged, never aborted, never finished ---------------
+    v_code, v_out, v_err = outs[victim]
+    assert v_code != 0, (v_code, v_out, v_err)
+    assert _parse_cte(v_out) is None, v_out
+    assert "DONE" not in v_out, v_out
+    assert dict(_steps(v_out)) == {0: 30.0}, v_out  # full-gang step 0
+
+    # -- the survivors: same typed error, same wedged rank, in time -----
+    replays = {}
+    for rank in range(np_ - 1):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        cte = _parse_cte(out)
+        assert cte is not None, (rank, out, err)
+        ranks, tensor, dt = cte
+        assert ranks == [victim], (rank, cte)
+        assert dt < 2.0 * TIMEOUT_S, (rank, cte)
+        steps = dict(_steps(out))
+        # Step 0 over the full gang; steps 1-3 re-run over the
+        # re-formed 2-rank gang (element 0 of grad.a = 10r + 100s).
+        assert steps == {0: 30.0, 1: 210.0, 2: 410.0, 3: 610.0}, steps
+        assert "FINAL_EPOCH 1" in out, out
+        assert "DONE" in out, out
+        replays[rank] = _parse_replays(out)
+
+    # Survivors agree on the same CTE tensor name.
+    assert _parse_cte(outs[0][1])[1] == _parse_cte(outs[1][1])[1]
+
+    # -- evict-and-replay: bit-identical to the fused oracle ------------
+    # Both survivors replayed the identical fused batch (same names,
+    # same result bytes), and each tensor equals the float32 sum of the
+    # survivors' retained step-1 inputs.
+    assert replays[0] == replays[1], replays
+    assert len(replays[0]) == 3, replays[0]
+    for j, nm in enumerate(("grad.a", "grad.b", "grad.c")):
+        matches = [k for k in replays[0] if f"{nm}.s1" in k]
+        assert len(matches) == 1, (nm, replays[0])
+        oracle = (_grad(0, 1, j) + _grad(1, 1, j)).tobytes().hex()
+        assert replays[0][matches[0]] == oracle, (nm, replays[0])
+
+    # -- timeline: the abort is a first-class record --------------------
+    tl = tl_path.read_text()
+    assert "COLLECTIVE_ABORT" in tl, tl[-2000:]
+    assert "ELASTIC_REFORM" in tl, tl[-2000:]
+
+
+@pytest.mark.timeout(60)
+def test_abort_metrics_registered():
+    """The abort counters exist in the registry schema (the gang test
+    cannot scrape its subprocesses' registries cheaply)."""
+    from horovod_tpu.telemetry.registry import KNOWN_METRICS
+
+    assert "hvd_collective_timeouts_total" in KNOWN_METRICS
+    assert "hvd_collective_abort_seconds" in KNOWN_METRICS
+
+
+# ---------------------------------------------------------------------------
+# hvdrun flag plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_cli_collective_timeout_validation():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run",
+         "-np", "2", "--collective-timeout", "-1",
+         sys.executable, "-c", "pass"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert res.returncode == 2, (res.stdout, res.stderr)
+    assert "--collective-timeout" in res.stderr, res.stderr
+
+
+def test_config_parser_maps_collective_timeout():
+    from horovod_tpu.runner.config_parser import _ARG_ENV
+
+    assert _ARG_ENV["collective_timeout"] == env_util.COLLECTIVE_TIMEOUT

@@ -1,0 +1,123 @@
+"""Collective-deadline scenario worker for tests/test_timeouts.py.
+
+A 3-rank elastic gang trains under ``HVD_COLLECTIVE_TIMEOUT``.  The
+victim rank (``TIMEOUT_VICTIM=1``) installs a ``sock.stall`` fault plan
+right before submitting step 1's fused gradient batch, wedging its own
+data-plane receive "forever" (GC-pause / partition-style hang: the
+process is alive, heartbeats are NOT flowing because the background
+thread is the one asleep, and nothing ever errors).  The survivors must:
+
+* blow the collective deadline locally,
+* agree gang-wide on WHO is wedged (every survivor raises the same
+  ``CollectiveTimeoutError`` naming the victim — not each other, even
+  though a blocked ring makes every rank *look* stuck to its neighbor),
+* re-form without the victim under ``@hvd.elastic.run``, and
+* replay the aborted fused batch from its retained original inputs.
+
+Markers (``flush=True`` so the driver parses them even on abrupt death):
+
+* ``STEP <i> <v>``       — element 0 of the step's first reduced tensor.
+* ``CTE ranks=<json> tensor=<name> dt=<s>`` — the typed abort, with the
+  submit->raise latency the driver bounds by 2x the timeout.
+* ``REPLAY <name> <hex>`` — one replayed tensor's exact result bytes.
+* ``FINAL_EPOCH <e>`` / ``DONE`` — loop completion (survivors only; the
+  victim stays wedged until the driver kills it).
+
+Exit codes: 0 scenario complete; the victim never exits on its own.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+TOTAL_STEPS = 4
+VICTIM_STEP = 1
+N = 8
+NAMES = ("grad.a", "grad.b", "grad.c")
+
+
+def grad(rank, step, j):
+    """Deterministic per-(rank, step, tensor) input; mirrored by the
+    driving test's fused-oracle computation."""
+    return (np.arange(N, dtype=np.float32) * (j + 1)
+            + 10.0 * rank + 100.0 * step).astype(np.float32)
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection as fi
+    from horovod_tpu.common.types import CollectiveTimeoutError
+    from horovod_tpu.ops import eager
+
+    victim = os.environ.get("TIMEOUT_VICTIM") == "1"
+
+    hvd.init()
+    from horovod_tpu import basics
+
+    assert type(basics._runtime).__name__ == "PyEngine"
+
+    state = hvd.elastic.ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        replayed = hvd.elastic.last_replay_results()
+        if replayed:
+            for nm in sorted(replayed):
+                print(f"REPLAY {nm} "
+                      f"{np.asarray(replayed[nm]).tobytes().hex()}",
+                      flush=True)
+        while state.step < TOTAL_STEPS:
+            rank = hvd.rank()
+            if victim and state.step == VICTIM_STEP:
+                # Wedge this rank's next ring-hop receive, in-process
+                # (no `after` counting against bootstrap collectives).
+                fi.configure({"faults": [
+                    {"site": "sock.stall", "kind": "stall",
+                     "stall_s": 600}]})
+            t0 = time.monotonic()
+            try:
+                handles = [eager.allreduce_async(
+                    grad(rank, state.step, j), op=hvd.Sum,
+                    name=f"{nm}.s{state.step}")
+                    for j, nm in enumerate(NAMES)]
+                outs = [eager.synchronize(h) for h in handles]
+            except CollectiveTimeoutError as e:
+                dt = time.monotonic() - t0
+                print(f"CTE ranks={json.dumps(e.ranks)} "
+                      f"tensor={e.tensor_name} dt={dt:.3f}", flush=True)
+                raise  # the elastic wrapper owns evict-and-replay
+            print(f"STEP {state.step} {float(np.asarray(outs[0])[0])}",
+                  flush=True)
+            state.step += 1
+            state.commit()
+
+    train(state)
+
+    # Poisoned-socket hygiene: the abort tears the wedged peer's sender
+    # down with the old mesh; nothing leaks into the re-formed gang
+    # (same contract as tests/elastic_worker.py).
+    import threading
+
+    def senders():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("hvd-send-")]
+
+    assert len(senders()) <= hvd.size() - 1, \
+        f"sender pool leaked across the abort: " \
+        f"{[t.name for t in senders()]}"
+    print(f"FINAL_EPOCH {os.environ.get('HVD_ELASTIC_EPOCH', '0')}",
+          flush=True)
+    print("DONE", flush=True)
+    hvd.shutdown()
+    deadline = time.monotonic() + 10.0
+    while senders() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not senders(), \
+        f"sender threads survived shutdown: " \
+        f"{[t.name for t in senders()]}"
+
+
+if __name__ == "__main__":
+    main()
