@@ -152,6 +152,39 @@ def _fused_small_tensor_worker(iters: int, k: int, count: int) -> float:
     return iters * k / dt
 
 
+def _eager_allreduce_images_worker(iters: int, counts, batch: int) -> float:
+    """Runs on every rank of an 8-way same-host eager gang: one "step"
+    allreduces a fused gradient batch of ``counts`` fp32 tensors (the
+    data-plane work a ``batch``-image training step would ship), so
+    images/sec = iters * batch / elapsed.  The driver runs it twice —
+    once with the shm intra-host transport on (the default for same-host
+    peers) and once with ``HVD_SHM_DISABLE=1`` — so the pair isolates
+    exactly the transport swap on an identical workload."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank = hvd.rank()
+    xs = [np.random.RandomState(rank * 7 + i).randn(c).astype(np.float32)
+          for i, c in enumerate(counts)]
+
+    def one():
+        hs = [hvd.allreduce_async(xs[i], op=hvd.Sum, name=f"grad.{i}")
+              for i in range(len(xs))]
+        for h in hs:
+            hvd.synchronize(h)
+
+    one()
+    one()  # second warm pass lands on the response cache
+    hvd.barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        one()
+    dt = time.perf_counter() - t0
+    return iters * batch / dt
+
+
 def main() -> None:
     from horovod_tpu.utils.platform import (
         default_backend_alive,
@@ -441,6 +474,29 @@ def main() -> None:
             per_rank[0], 1)
     except Exception as e:
         extras["fused_small_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- eager 8-way transport shoot-out: shm rings vs loopback TCP -----
+    # Same workload, same gang shape, only the intra-host transport
+    # differs: an 8-rank same-host gang pairs over seqlock'd /dev/shm
+    # rings by default; HVD_SHM_DISABLE=1 pins the seed's loopback-TCP
+    # path.  4x 1 MiB fp32 tensors per step is a ResNet-scale fused
+    # gradient batch, large enough that transport bandwidth (not Python
+    # dispatch) dominates.
+    try:
+        from horovod_tpu.runner.run import run as hvd_run
+
+        counts, tr_iters, tr_batch = [1 << 18] * 4, 10, 32
+        tr_env = {"HVD_TPU_CORE": "py", "JAX_PLATFORMS": "cpu"}
+        shm_rates = hvd_run(
+            _eager_allreduce_images_worker, (tr_iters, counts, tr_batch),
+            np=8, env=tr_env)
+        extras["allreduce_shm_images_per_sec"] = round(shm_rates[0], 2)
+        tcp_rates = hvd_run(
+            _eager_allreduce_images_worker, (tr_iters, counts, tr_batch),
+            np=8, env={**tr_env, "HVD_SHM_DISABLE": "1"})
+        extras["allreduce_tcp_images_per_sec"] = round(tcp_rates[0], 2)
+    except Exception as e:
+        extras["transport_bench_error"] = f"{type(e).__name__}: {e}"[:200]
 
     baseline = 1656.82 / 16.0  # reference's per-device number
     line = {

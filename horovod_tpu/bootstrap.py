@@ -30,15 +30,25 @@ def bootstrap_mesh(
     size: int,
     rdv_addr: str,
     rdv_port: int,
+    shm_capable: bool = False,
 ) -> Tuple[Dict[int, socket.socket], Optional[socket.socket],
-           Dict[int, socket.socket]]:
-    """Returns ``(data, ctrl_sock, ctrl_socks)``:
+           Dict[int, socket.socket], object, str]:
+    """Returns ``(data, ctrl_sock, ctrl_socks, kv, prefix)``:
 
     * ``data``: peer rank -> connected data socket (full mesh),
     * ``ctrl_sock``: worker's connection to the coordinator (None on rank 0),
-    * ``ctrl_socks``: coordinator's per-worker sockets (empty off rank 0).
+    * ``ctrl_socks``: coordinator's per-worker sockets (empty off rank 0),
+    * ``kv`` / ``prefix``: the rendezvous client and key namespace, for
+      post-mesh negotiation (shm transport pairing).
+
+    ``shm_capable`` controls the host record published for transport
+    selection: only engines that can speak the shm ring transport (the
+    Python engine) publish a matching same-host fingerprint; everyone
+    else (native engine) publishes a rank-unique token so peers always
+    pair with them over TCP.
     """
     from horovod_tpu.runner.http_client import KVClient
+    from horovod_tpu.utils import transport as tpt
 
     _fi.fire("bootstrap.start", str(rank))
     # Launcher-provided startup budget (hvdrun --start-timeout);
@@ -68,6 +78,9 @@ def bootstrap_mesh(
             my_host = None  # NIC list from another host; fall back
     my_host = my_host or kv.local_address() or "127.0.0.1"
     kv.put(f"{prefix}addr/{rank}", f"{my_host}:{port}")
+    # Host record for same-host transport selection (utils/transport.py).
+    kv.put(f"{prefix}hostid/{rank}",
+           tpt.host_record_value(rank, shm_capable))
     peers = {}
     for i in range(size):
         if i == rank:
@@ -117,4 +130,4 @@ def bootstrap_mesh(
         else:
             ctrl_socks[peer_rank] = s
     listener.close()
-    return data, ctrl_sock, ctrl_socks
+    return data, ctrl_sock, ctrl_socks, kv, prefix

@@ -83,6 +83,8 @@ KNOWN_SITES = {
     "ctrl.coord.send": "coordinator->worker control send",
     "sock.stall": "data-plane ring-hop receive (hang simulation)",
     "sock.halfopen": "persistent sender thread send (half-open sim)",
+    "shm.stall": "data-plane shm ring receive (hang simulation)",
+    "shm.attach": "shm segment attach during transport pairing",
     "train.step": "user-level per-step site (training scripts)",
     # data plane (should_corrupt)
     "grad.nonfinite": "poison local gradients with NaN (eager guard)",

@@ -46,6 +46,7 @@ from horovod_tpu.ops.fusion_buffer import FusionBuffer
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
+from horovod_tpu.utils import transport as tpt
 
 
 def _np_dtype(dt: DataType):
@@ -80,12 +81,12 @@ def _deadline(engine) -> Optional[float]:
     return (time.monotonic() + t) if t > 0 else None
 
 
-def _wait_send(sender: su.PeerSender, ticket: int,
+def _wait_send(sender, ticket: int,
                deadline: Optional[float], peer: int) -> None:
-    """``PeerSender.wait`` with a timeout ALWAYS set: the collective
-    deadline when one is active, else the generous always-on
-    ``HVD_SEND_WAIT_CAP_S`` backstop — a dead sender thread must never
-    hang a hop silently."""
+    """``wait(ticket)`` on a transport or raw ``PeerSender`` with a
+    timeout ALWAYS set: the collective deadline when one is active, else
+    the generous always-on ``HVD_SEND_WAIT_CAP_S`` backstop — a dead
+    sender thread must never hang a hop silently."""
     if deadline is None:
         cap = max(0.001, env_util.send_wait_cap_s())
     else:
@@ -98,10 +99,10 @@ def _wait_send(sender: su.PeerSender, ticket: int,
         raise HopTimeout(peer, "send") from None
 
 
-def _recv_exact_hop(sock, view: memoryview, deadline: Optional[float],
-                    peer: int) -> None:
+def _recv_exact_hop(tr: tpt.Transport, view: memoryview,
+                    deadline: Optional[float], peer: int) -> None:
     try:
-        su.recv_exact_into(sock, view, deadline)
+        tr.recv_exact_into(view, deadline)
     except TimeoutError:
         raise HopTimeout(peer, "recv") from None
 
@@ -117,6 +118,21 @@ def _sender(engine, rank: int) -> su.PeerSender:
         s = senders[rank] = su.PeerSender(
             engine._data[rank], name=f"hvd-send-{rank}")
     return s
+
+
+def _transport(engine, rank: int) -> tpt.Transport:
+    """The peer link for ``rank``: selected at engine bootstrap (shm ring
+    for same-host peers, TCP otherwise); lazily wrapped here for bare
+    test engines, reusing the engine's persistent ``PeerSender`` so no
+    second sender thread ever appears for a peer."""
+    transports = getattr(engine, "_transports", None)
+    if transports is None:
+        transports = engine._transports = {}
+    t = transports.get(rank)
+    if t is None:
+        t = transports[rank] = tpt.TcpTransport(
+            engine._data[rank], peer=rank, sender=_sender(engine, rank))
+    return t
 
 
 def _scratch(engine) -> FusionBuffer:
@@ -135,10 +151,12 @@ def _segment_elems(engine, itemsize: int) -> int:
     return max(1, seg // itemsize)
 
 
-def _recv(sock, deadline: Optional[float] = None, peer: int = -1) -> bytes:
-    _fi.fire("sock.stall")
+def _recv(tr: tpt.Transport, deadline: Optional[float] = None,
+          peer: int = -1) -> bytes:
+    # The stall chaos site (sock.stall / shm.stall) fires inside the
+    # transport's recv_frame, preserving one fire per received frame.
     try:
-        tag, payload = su.recv_frame(sock, deadline)
+        tag, payload = tr.recv_frame(deadline)
     except TimeoutError:
         raise HopTimeout(peer, "recv") from None
     if tag != su.TAG_DATA:
@@ -146,11 +164,10 @@ def _recv(sock, deadline: Optional[float] = None, peer: int = -1) -> bytes:
     return payload
 
 
-def _recv_data_header(sock, deadline: Optional[float] = None,
+def _recv_data_header(tr: tpt.Transport, deadline: Optional[float] = None,
                       peer: int = -1) -> int:
-    _fi.fire("sock.stall")
     try:
-        tag, nbytes = su.recv_frame_header(sock, deadline)
+        tag, nbytes = tr.recv_frame_header(deadline)
     except TimeoutError:
         raise HopTimeout(peer, "recv") from None
     if tag != su.TAG_DATA:
@@ -158,16 +175,16 @@ def _recv_data_header(sock, deadline: Optional[float] = None,
     return nbytes
 
 
-def _recv_into(sock, dst: np.ndarray, deadline: Optional[float] = None,
-               peer: int = -1) -> None:
+def _recv_into(tr: tpt.Transport, dst: np.ndarray,
+               deadline: Optional[float] = None, peer: int = -1) -> None:
     """Receive one data frame straight into ``dst`` (contiguous view)."""
-    nbytes = _recv_data_header(sock, deadline, peer)
+    nbytes = _recv_data_header(tr, deadline, peer)
     if nbytes != dst.nbytes:
         raise ConnectionError(
             f"ring hop size mismatch: got {nbytes} bytes, expected "
             f"{dst.nbytes}")
     if nbytes:
-        _recv_exact_hop(sock, memoryview(dst.view(np.uint8)), deadline,
+        _recv_exact_hop(tr, memoryview(dst.view(np.uint8)), deadline,
                         peer)
 
 
@@ -232,17 +249,18 @@ def _combine_into(incoming: np.ndarray, mine: np.ndarray, op: ReduceOp,
     _combine_out(incoming, mine, mine, op)
 
 
-def _recv_combine(sock, mine: np.ndarray, hop: np.ndarray,
+def _recv_combine(tr: tpt.Transport, mine: np.ndarray, hop: np.ndarray,
                   hop_mv: memoryview, op: ReduceOp, seg: int,
                   fb: FusionBuffer, deadline: Optional[float] = None,
                   peer: int = -1) -> None:
     """Receive one hop's chunk and reduce it into ``mine`` in place.
 
     With ``seg`` > 0, the payload is drained in ``seg``-element slices:
-    while numpy reduces slice k, the kernel keeps receiving slice k+1
-    into the socket buffer — the DeAR-style transfer/reduction overlap,
-    with no extra threads and no wire-format change."""
-    nbytes = _recv_data_header(sock, deadline, peer)
+    while numpy reduces slice k, the peer (kernel socket buffer or shm
+    ring writer) keeps producing slice k+1 — the DeAR-style
+    transfer/reduction overlap, with no extra threads and no
+    wire-format change."""
+    nbytes = _recv_data_header(tr, deadline, peer)
     n = mine.size
     isz = mine.itemsize
     if nbytes != n * isz:
@@ -252,13 +270,13 @@ def _recv_combine(sock, mine: np.ndarray, hop: np.ndarray,
     if n == 0:
         return
     if seg <= 0 or seg >= n:
-        _recv_exact_hop(sock, hop_mv[:nbytes], deadline, peer)
+        _recv_exact_hop(tr, hop_mv[:nbytes], deadline, peer)
         _combine_into(hop[:n], mine, op, fb)
         return
     done = 0
     while done < n:
         k = min(seg, n - done)
-        _recv_exact_hop(sock, hop_mv[done * isz:(done + k) * isz],
+        _recv_exact_hop(tr, hop_mv[done * isz:(done + k) * isz],
                         deadline, peer)
         _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
         done += k
@@ -298,8 +316,8 @@ def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
         return flat
     right_rank = group[(me + 1) % size]
     left_rank = group[(me - 1) % size]
-    right = _sender(engine, right_rank)
-    left = engine._data[left_rank]
+    right = _transport(engine, right_rank)
+    left = _transport(engine, left_rank)
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, size)
     max_chunk = max(bounds[i + 1] - bounds[i] for i in range(size))
@@ -366,8 +384,8 @@ def hierarchical_allreduce_flat(engine, flat: np.ndarray, op: ReduceOp,
     local = _local_group(engine)
     right_rank = local[(li + 1) % L]
     left_rank = local[(li - 1) % L]
-    right = _sender(engine, right_rank)
-    left = engine._data[left_rank]
+    right = _transport(engine, right_rank)
+    left = _transport(engine, left_rank)
     dtype = flat.dtype
     bounds = _chunk_bounds(flat.size, L)
     max_chunk = max(bounds[i + 1] - bounds[i] for i in range(L))
@@ -422,12 +440,11 @@ def _adasum_flat(engine, flat: np.ndarray,
     k = 1
     while k < size:
         partner = rank ^ k
-        sock = engine._data[partner]
-        sender = _sender(engine, partner)
-        ticket = sender.send(acc)
-        other = np.frombuffer(_recv(sock, deadline, partner),
+        tr = _transport(engine, partner)
+        ticket = tr.send(acc)
+        other = np.frombuffer(_recv(tr, deadline, partner),
                               dtype=np.float64).copy()
-        _wait_send(sender, ticket, deadline, partner)
+        _wait_send(tr, ticket, deadline, partner)
         if rank < partner:
             acc = adasum_pair_numpy(acc, other)
         else:
@@ -575,8 +592,8 @@ def _allgather_hierarchical(engine, entries, resp: Response):
         blocks[li] = np.ascontiguousarray(e.array).tobytes()
         right_rank = local[(li + 1) % L]
         left_rank = local[(li - 1) % L]
-        right = _sender(engine, right_rank)
-        left = engine._data[left_rank]
+        right = _transport(engine, right_rank)
+        left = _transport(engine, left_rank)
         for step in range(L - 1):
             send_idx = (li - step) % L
             recv_idx = (li - step - 1) % L
@@ -593,8 +610,8 @@ def _allgather_hierarchical(engine, entries, resp: Response):
             if C > 1:
                 nright_rank = ((me + 1) % C) * L
                 nleft_rank = ((me - 1) % C) * L
-                nright = _sender(engine, nright_rank)
-                nleft = engine._data[nleft_rank]
+                nright = _transport(engine, nright_rank)
+                nleft = _transport(engine, nleft_rank)
                 for step in range(C - 1):
                     send_idx = (me - step) % C
                     recv_idx = (me - step - 1) % C
@@ -605,12 +622,13 @@ def _allgather_hierarchical(engine, entries, resp: Response):
             # Phase 3: fan the full buffer out to the rest of the node
             # on their persistent senders (the seed spawned a thread per
             # peer per tensor here).
-            tickets = [(r, _sender(engine, r), _sender(engine, r).send(full))
+            tickets = [(r, _transport(engine, r),
+                        _transport(engine, r).send(full))
                        for r in local[1:]]
             for r, s, ticket in tickets:
                 _wait_send(s, ticket, dl, r)
         else:
-            full = _recv(engine._data[local[0]], dl, local[0])
+            full = _recv(_transport(engine, local[0]), dl, local[0])
 
         arr = np.frombuffer(full, dtype=dtype).copy()
         results.append(arr.reshape((sum(first_dims),) + rest_shape))
@@ -666,8 +684,8 @@ def _allgather_flat(engine, entries, resp: Response):
         if size > 1:
             right_rank = group[(me + 1) % size]
             left_rank = group[(me - 1) % size]
-            right = _sender(engine, right_rank)
-            left = engine._data[left_rank]
+            right = _transport(engine, right_rank)
+            left = _transport(engine, left_rank)
             for step in range(size - 1):
                 send_idx = (me - step) % size
                 recv_idx = (me - step - 1) % size
@@ -710,8 +728,8 @@ def reducescatter(engine, entries, resp: Response):
                   for i in range(size)]
         right_rank = group[(me + 1) % size]
         left_rank = group[(me - 1) % size]
-        right = _sender(engine, right_rank)
-        left = engine._data[left_rank]
+        right = _transport(engine, right_rank)
+        left = _transport(engine, left_rank)
         # Virtual rank (me-1): the standard walk leaves member r owning
         # chunk (r+1)%size; shifting by one leaves it owning chunk r.
         for step in range(size - 1):
@@ -746,14 +764,14 @@ def broadcast(engine, entries, resp: Response):
             continue
         if rank == root:
             payload = np.ascontiguousarray(e.array)
-            tickets = [(r, _sender(engine, r),
-                        _sender(engine, r).send(payload))
+            tickets = [(r, _transport(engine, r),
+                        _transport(engine, r).send(payload))
                        for r in group if r != root]
             for r, s, ticket in tickets:
                 _wait_send(s, ticket, dl, r)
             results.append(e.array.copy())
         else:
-            payload = _recv(engine._data[root], dl, root)
+            payload = _recv(_transport(engine, root), dl, root)
             arr = np.frombuffer(
                 payload, dtype=_np_dtype(resp.tensor_type)).copy()
             results.append(arr.reshape(e.array.shape))
@@ -786,9 +804,10 @@ def alltoall(engine, entries, resp: Response):
         for step in range(1, size):
             dst = (rank + step) % size
             src = (rank - step) % size
-            sender = _sender(engine, group[dst])
+            sender = _transport(engine, group[dst])
             ticket = sender.send(my_blocks[dst])
-            payload = _recv(engine._data[group[src]], dl, group[src])
+            payload = _recv(_transport(engine, group[src]), dl,
+                            group[src])
             _wait_send(sender, ticket, dl, group[dst])
             blk = np.frombuffer(payload, dtype=dtype)
             if rest_shape:

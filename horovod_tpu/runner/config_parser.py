@@ -22,6 +22,9 @@ _ARG_ENV = {
     "ring_segment_bytes": E.RING_SEGMENT_BYTES,
     "sock_buf_bytes": E.SOCK_BUF_BYTES,
     "collective_timeout": E.COLLECTIVE_TIMEOUT,
+    "no_shm": E.SHM_DISABLE,
+    "shm_slot_bytes": E.SHM_SLOT_BYTES,
+    "shm_slots": E.SHM_SLOTS,
     "timeline_filename": E.TIMELINE,
     "timeline_mark_cycles": E.TIMELINE_MARK_CYCLES,
     "no_stall_check": E.STALL_CHECK_DISABLE,
@@ -39,7 +42,7 @@ _ARG_ENV = {
 
 _MB = {"fusion_threshold_mb"}
 _BOOL = {"hierarchical_allreduce", "hierarchical_allgather",
-         "timeline_mark_cycles", "no_stall_check", "autotune"}
+         "timeline_mark_cycles", "no_stall_check", "autotune", "no_shm"}
 
 
 def _convert(dest: str, v) -> Optional[str]:

@@ -234,6 +234,19 @@ def make_parser() -> argparse.ArgumentParser:
                            "CollectiveTimeoutError instead of "
                            "deadlocking; 0 (default) blocks forever "
                            "(see docs/fault_tolerance.md)")
+    tune.add_argument("--no-shm", action="store_true", dest="no_shm",
+                      help="disable the same-host shared-memory "
+                           "transport: every peer link uses TCP, the "
+                           "pre-shm wire path (escape hatch; see "
+                           "docs/performance.md)")
+    tune.add_argument("--shm-slot-bytes", type=int,
+                      dest="shm_slot_bytes",
+                      help="payload bytes per shm ring slot (default "
+                           "262144, floor 4096; see "
+                           "docs/performance.md)")
+    tune.add_argument("--shm-slots", type=int, dest="shm_slots",
+                      help="slots per directed shm ring (default 16, "
+                           "floor 2; see docs/performance.md)")
 
     auto = p.add_argument_group("autotune")
     auto.add_argument("--autotune", action="store_true", dest="autotune")
@@ -319,6 +332,14 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         if val is not None and val < 0:
             print(f"{_prog_name()}: {flag} must be >= 0 "
                   f"(got {val}; 0 disables)", file=sys.stderr)
+            return 2
+    for flag, val, floor in (("--shm-slot-bytes", args.shm_slot_bytes,
+                              4096),
+                             ("--shm-slots", args.shm_slots, 2)):
+        if val is not None and val < floor:
+            print(f"{_prog_name()}: {flag} must be >= {floor} "
+                  f"(got {val}); use --no-shm to disable the shm "
+                  "transport entirely", file=sys.stderr)
             return 2
     # Elastic flags: validate at parse time, before any rendezvous/ssh
     # side effects — a bad floor/ceiling or a missing discovery script

@@ -67,8 +67,11 @@ class NativeEngine:
         # a Python writer here would clobber the same file.
         self.timeline = timeline_mod.Timeline()
 
-        data, ctrl_sock, ctrl_socks = bootstrap_mesh(
-            rank, size, rdv_addr, rdv_port)
+        # shm_capable=False: the C++ core speaks TCP only, and the
+        # published host record keeps every peer (including Python
+        # engines on the same host) on the socket path against us.
+        data, ctrl_sock, ctrl_socks, _kv, _prefix = bootstrap_mesh(
+            rank, size, rdv_addr, rdv_port, shm_capable=False)
 
         # Hand the connected fds to the core, which owns them from now on.
         data_fds = (ctypes.c_int32 * size)(*[-1] * size)

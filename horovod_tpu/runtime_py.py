@@ -494,19 +494,30 @@ class PyEngine(_EngineBase):
     def _bootstrap(self, rdv_addr: str, rdv_port: int) -> None:
         from horovod_tpu.bootstrap import bootstrap_mesh
 
-        self._data, self._ctrl_sock, self._ctrl_socks = bootstrap_mesh(
-            self.rank, self.size, rdv_addr, rdv_port)
+        (self._data, self._ctrl_sock, self._ctrl_socks,
+         kv, kv_prefix) = bootstrap_mesh(
+            self.rank, self.size, rdv_addr, rdv_port, shm_capable=True)
 
-        # Data-plane hot-path state (docs/performance.md): one persistent
-        # sender thread per peer socket — ring hops enqueue sends instead
-        # of spawning a thread per hop — plus the persistent fusion/hop
+        # Data-plane hot-path state (docs/performance.md): one transport
+        # per peer, selected at mesh-build time (shm ring for same-host
+        # peers unless HVD_SHM_DISABLE, TCP otherwise), each with one
+        # persistent sender thread — ring hops enqueue sends instead of
+        # spawning a thread per hop — plus the persistent fusion/hop
         # scratch the collectives pack into.  Torn down in shutdown();
-        # an elastic re-form goes through shutdown() + a fresh engine, so
-        # re-bootstrap always starts from an empty pool.
+        # an elastic re-form goes through shutdown() + a fresh engine
+        # under a new rendezvous scope, so re-bootstrap always starts
+        # from an empty pool and fresh pairing keys.
         from horovod_tpu.ops.fusion_buffer import FusionBuffer
+        from horovod_tpu.utils import transport as tpt
 
-        self._senders = {r: su.PeerSender(s, name=f"hvd-send-{r}")
-                         for r, s in self._data.items()}
+        self._transports = tpt.build_transports(
+            self.rank, self.size, self._data, kv, kv_prefix)
+        # TCP transports own the engine's PeerSenders; shm peers have no
+        # socket sender (their thread lives inside the transport), so the
+        # per-peer sender-thread count stays exactly one either way.
+        self._senders = {r: t.sender
+                         for r, t in self._transports.items()
+                         if t.kind == "tcp"}
         self._fusion_buf = FusionBuffer()
 
         # ctrl receiver threads
@@ -769,6 +780,18 @@ class PyEngine(_EngineBase):
         # Stop the persistent senders first (drains queued frames while
         # the sockets are still open), then close sockets — which also
         # unblocks any sender stuck mid-write to a dead peer — and join.
+        # Shm transports go first: their close drains, breaks any writer
+        # spinning on a dead peer's full ring via the stop flag, joins
+        # the hvd-send-shm-* thread, and unmaps the segment (the /dev/shm
+        # name was already unlinked at pairing time, so nothing can leak
+        # even if this process dies before reaching here).
+        transports = list(getattr(self, "_transports", {}).values())
+        for t in transports:
+            if t.kind != "tcp":
+                try:
+                    t.close(timeout=2.0)
+                except Exception:
+                    pass
         senders = list(getattr(self, "_senders", {}).values())
         for snd in senders:
             try:
@@ -790,6 +813,12 @@ class PyEngine(_EngineBase):
         # the join so shutdown stays prompt even for a wedged thread.
         for snd in senders:
             snd.thread.join(timeout=2.0)
+        for t in transports:
+            try:
+                t.join(timeout=2.0)
+            except Exception:
+                pass
+        self._transports = {}
 
     # ------------------------------------------------------------------
     # background loop

@@ -97,6 +97,10 @@ KNOWN_METRICS: Dict[str, dict] = {
     "hvd_dataplane_alloc_bytes": _counter(
         "Bytes allocated growing the persistent data-plane buffers "
         "(fusion, hop, and fp32 scratch); flat in steady state."),
+    "hvd_transport_bytes_total": _counter(
+        "Payload bytes enqueued on the eager data plane, by transport "
+        "(shm for same-host peers, tcp otherwise).",
+        labels=("transport",)),
     # -- response cache (common/response_cache.py via the engine) --
     "hvd_cache_hits_total": _counter(
         "Response-cache hits in request classification."),

@@ -34,6 +34,14 @@ ADASUM_MODE = "HVD_ADASUM_MODE"
 # socket (both the dialing and the accepting side).
 RING_SEGMENT_BYTES = "HVD_RING_SEGMENT_BYTES"
 SOCK_BUF_BYTES = "HVD_SOCK_BUF_BYTES"
+# Same-host shm transport (horovod_tpu.utils.transport;
+# docs/performance.md "Transport selection").  SHM_DISABLE forces every
+# peer link onto TCP (the escape hatch for a bad shm path); SLOT_BYTES /
+# SLOTS size each directed ring (per peer pair: 2 rings of SLOTS slots
+# of SLOT_BYTES payload each, floors 4096 bytes / 2 slots).
+SHM_DISABLE = "HVD_SHM_DISABLE"
+SHM_SLOT_BYTES = "HVD_SHM_SLOT_BYTES"
+SHM_SLOTS = "HVD_SHM_SLOTS"
 # Liveness / fault tolerance (PyEngine; 0 = heartbeats disabled).
 # HOROVOD_HEARTBEAT_TIMEOUT is accepted as an alias of the HVD_ name.
 HEARTBEAT_TIMEOUT = "HVD_HEARTBEAT_TIMEOUT"
@@ -130,6 +138,23 @@ def cycle_time_ms() -> float:
 def ring_segment_bytes() -> int:
     """Ring-hop segment size; 0 (default) disables segmentation."""
     return max(0, get_int(RING_SEGMENT_BYTES, 0))
+
+
+def shm_disabled() -> bool:
+    """True when the same-host shm transport is forced off (escape
+    hatch: every peer link falls back to TCP)."""
+    return get_bool(SHM_DISABLE, False)
+
+
+def shm_slot_bytes() -> int:
+    """Payload bytes per shm ring slot; floor 4096."""
+    return max(4096, get_int(SHM_SLOT_BYTES, 256 * 1024))
+
+
+def shm_slots() -> int:
+    """Slots per directed shm ring; floor 2 (writer needs one slot in
+    flight while the reader drains another)."""
+    return max(2, get_int(SHM_SLOTS, 16))
 
 
 def collective_timeout_s() -> float:

@@ -39,6 +39,7 @@ from horovod_tpu.common.types import (
 from horovod_tpu.ops import cpu_backend as cb
 from horovod_tpu.ops.fusion_buffer import FusionBuffer
 from horovod_tpu.utils import socketutil as su
+from horovod_tpu.utils import transport as tpt
 
 
 def _dt(np_dtype) -> DataType:
@@ -77,6 +78,10 @@ class FakeEngine:
         return True
 
     def close(self):
+        for t in getattr(self, "_transports", {}).values():
+            with contextlib.suppress(Exception):
+                t.close(timeout=2.0)
+        self._transports = {}
         for snd in getattr(self, "_senders", {}).values():
             with contextlib.suppress(Exception):
                 snd.close(timeout=2.0)
@@ -86,11 +91,25 @@ class FakeEngine:
                 s.close()
 
 
+def _shm_pair(a_rank, b_rank):
+    """In-process shm transport pair, create/attach/immediate-unlink
+    exactly like the runtime pairing protocol (small rings so the
+    multi-slot paths get exercised)."""
+    seg_a = tpt.ShmSegment.create(slot_bytes=4096, nslots=4)
+    seg_b = tpt.ShmSegment.attach(seg_a.name)
+    seg_a.unlink()
+    return (tpt.ShmRingTransport(seg_a, lower=True, peer=b_rank),
+            tpt.ShmRingTransport(seg_b, lower=False, peer=a_rank))
+
+
 @contextlib.contextmanager
-def mesh(members, size=None, seg=0, local_size=None):
+def mesh(members, size=None, seg=0, local_size=None, shm=False):
     """Full socketpair mesh over ``members`` (global ranks); yields
     {rank: FakeEngine}.  ``seg`` may be an int or {rank: int} so ranks
-    can run mixed segmentation (receiver-local knob)."""
+    can run mixed segmentation (receiver-local knob).  ``shm`` selects
+    the shm ring transport for every pair (True) or just the listed
+    ``(low, high)`` pairs (mixed shm/TCP gang); unlisted pairs fall to
+    TCP lazily, as in production."""
     members = list(members)
     socks = {r: {} for r in members}
     for i, a in enumerate(members):
@@ -103,6 +122,17 @@ def mesh(members, size=None, seg=0, local_size=None):
                       seg=(seg.get(r, 0) if isinstance(seg, dict) else seg),
                       local_size=local_size)
         for r in members}
+    if shm:
+        pairs = ([(a, b) for i, a in enumerate(members)
+                  for b in members[i + 1:]] if shm is True
+                 else [tuple(sorted(p)) for p in shm])
+        for a, b in pairs:
+            ta, tb = _shm_pair(a, b)
+            for eng in (engines[a], engines[b]):
+                if not hasattr(eng, "_transports"):
+                    eng._transports = {}
+            engines[a]._transports[b] = ta
+            engines[b]._transports[a] = tb
     try:
         yield engines
     finally:
@@ -259,9 +289,13 @@ def _np_of(name):
     return np.dtype(name)
 
 
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
 @pytest.mark.parametrize("op", _OPS, ids=lambda o: o.name.lower())
 @pytest.mark.parametrize("dtype", _DTYPES)
-def test_ring_allreduce_matches_oracle(dtype, op):
+def test_ring_allreduce_matches_oracle(dtype, op, transport):
+    """The oracle matrix runs once per transport: the shm ring must be
+    byte-equal to the TCP path (same oracle) for every dtype × op ×
+    segment size."""
     dtype = _np_of(dtype)
     rng = np.random.default_rng(7)
     shapes = [(5, 3), (8,), (1, 2)]  # 25 elements over 4 ranks: ragged
@@ -269,7 +303,8 @@ def test_ring_allreduce_matches_oracle(dtype, op):
     expect = fused_allreduce_oracle(per_rank, op, dtype)
     # seg=0 (one-gulp hops) and seg=7 elements (doesn't divide any chunk)
     for seg_bytes in (0, 7 * dtype.itemsize):
-        with mesh(range(4), seg=seg_bytes) as engines:
+        with mesh(range(4), seg=seg_bytes,
+                  shm=(transport == "shm")) as engines:
             results = _run_allreduce(engines, per_rank, op, dtype)
         _assert_all_equal(results, expect)
 
@@ -415,6 +450,97 @@ def test_hierarchical_segmented_matches_unsegmented():
         np.testing.assert_array_equal(base[rank][0], base[0][0])
 
 
+def test_mixed_shm_tcp_hierarchical_gang_matches_tcp():
+    """The production topology: shm for same-host (intra-node) pairs,
+    TCP across nodes, composed with the hierarchical allreduce — must be
+    byte-equal to the all-TCP gang, segmented or not."""
+    rng = np.random.default_rng(31)
+    per_rank = [_entry_arrays(rng, r, np.float32, [(19,), (3, 2)])
+                for r in range(4)]
+
+    def run(shm, seg):
+        with mesh(range(4), seg=seg, local_size=2, shm=shm) as engines:
+            for e in engines.values():
+                e.hierarchical_allreduce = True
+            return _run_allreduce(engines, per_rank, ReduceOp.SUM,
+                                  np.float32)
+
+    intra_node = [(0, 1), (2, 3)]
+    for seg in (0, 7 * 4):
+        tcp = run(False, seg)
+        mixed = run(intra_node, seg)
+        full_shm = run(True, seg)
+        for rank in tcp:
+            for j in range(len(per_rank[0])):
+                np.testing.assert_array_equal(
+                    tcp[rank][j].view(np.uint8),
+                    mixed[rank][j].view(np.uint8))
+                np.testing.assert_array_equal(
+                    tcp[rank][j].view(np.uint8),
+                    full_shm[rank][j].view(np.uint8))
+
+
+def test_shm_transport_deadline_raises_hop_timeout():
+    """A reader starved past the collective deadline raises the same
+    HopTimeout(peer, phase) the socket path raises (PR-6 composition)."""
+    a, b = _shm_pair(0, 1)
+    try:
+        deadline = time.monotonic() + 0.2
+        with pytest.raises(cb.HopTimeout) as ei:
+            cb._recv(b, deadline, 0)
+        assert ei.value.peer == 0 and ei.value.phase == "recv"
+    finally:
+        a.close(timeout=2.0)
+        b.close(timeout=2.0)
+
+
+def test_shm_segment_name_gone_while_traffic_flows():
+    """The pairing protocol unlinks /dev/shm names the moment both sides
+    are mapped — traffic keeps flowing with no named segment anywhere,
+    which is what makes a SIGKILL'd peer leak-proof by construction."""
+    import glob
+
+    a, b = _shm_pair(0, 1)
+    try:
+        assert not glob.glob(f"/dev/shm/{tpt._SHM_PREFIX}*")
+        payload = np.arange(5000, dtype=np.float32)
+        t = a.send(payload)
+        tag, got = b.recv_frame()
+        a.wait(t, timeout=5)
+        assert tag == su.TAG_DATA
+        np.testing.assert_array_equal(
+            np.frombuffer(got, np.float32), payload)
+        assert not glob.glob(f"/dev/shm/{tpt._SHM_PREFIX}*")
+    finally:
+        a.close(timeout=2.0)
+        b.close(timeout=2.0)
+    assert not [th for th in threading.enumerate()
+                if th.name.startswith("hvd-send-shm-")]
+
+
+@pytest.mark.timeout(170)
+@pytest.mark.parametrize("scenario", ["shutdown_reform", "sigkill"])
+def test_shm_no_leaks_across_gang_lifecycle(scenario):
+    """Real gangs (subprocess ranks, real bootstrap + KV pairing): no
+    /dev/shm segment and no sender thread survives shutdown, elastic
+    re-form, or a SIGKILL'd rank — and resource-tracker chatter (the
+    'leaked shared_memory' warnings) is treated as failure."""
+    import os
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "shm_worker.py")
+    proc = subprocess.run(
+        [sys.executable, worker, scenario],
+        capture_output=True, text=True, timeout=160,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out = proc.stdout + "\n" + proc.stderr
+    assert proc.returncode == 0, out
+    assert "CLEAN" in proc.stdout, out
+    assert "resource_tracker" not in out, out
+    assert "leaked" not in out.lower(), out
+
+
 def test_broadcast_and_allgather_ride_persistent_senders():
     arrays = {r: np.full((4, 2), float(r), np.float32) for r in range(3)}
     bresp = Response(response_type=ResponseType.BROADCAST,
@@ -471,7 +597,8 @@ def test_steady_state_spawns_no_threads_and_no_payload_allocs():
         after = threading.active_count()
 
     assert after == before, "steady-state collective changed thread count"
-    plane = ("cpu_backend.py", "socketutil.py", "fusion_buffer.py")
+    plane = ("cpu_backend.py", "socketutil.py", "fusion_buffer.py",
+             "transport.py")
     offenders = [
         (st.traceback[0].filename, st.traceback[0].lineno, st.size)
         for st in snap.statistics("traceback")

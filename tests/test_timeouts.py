@@ -421,9 +421,17 @@ def _steps(out):
             for m in re.finditer(r"STEP (\d+) ([\d.]+)", out)]
 
 
-@pytest.mark.timeout(150)
-def test_stalled_rank_gang_abort_evict_replay(tmp_path):
-    """One rank of three wedges mid-fused-reduction (``sock.stall``).
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("transport", ["tcp", "shm"])
+def test_stalled_rank_gang_abort_evict_replay(tmp_path, transport):
+    """One rank of three wedges mid-fused-reduction.  Parametrized over
+    the data-plane transport: the ``tcp`` variant pins
+    ``HVD_SHM_DISABLE=1`` and stalls ``sock.stall``; the ``shm`` variant
+    lets the same-host gang pair over shm rings and stalls
+    ``shm.stall`` — proving a wedged shm hop produces the identical
+    typed abort + evict-and-replay story (either variant fails if the
+    gang silently paired over the other transport, because then the
+    injected site never fires and the victim finishes on its own).
     Without the deadline subsystem this gang deadlocks forever — the
     victim is alive, nothing errors, heartbeats can't see it (the
     background thread doing heartbeats IS the wedged one).  With
@@ -468,6 +476,10 @@ def test_stalled_rank_gang_abort_evict_replay(tmp_path):
                 "HVD_COLLECTIVE_TIMEOUT": str(TIMEOUT_S),
                 "HVD_COLLECTIVE_PROBE_TIMEOUT": "0.5",
             })
+            if transport == "tcp":
+                env["HVD_SHM_DISABLE"] = "1"
+            else:
+                env["TIMEOUT_SITE"] = "shm.stall"
             if rank == victim:
                 env["TIMEOUT_VICTIM"] = "1"
             if rank == 0:
@@ -582,3 +594,27 @@ def test_config_parser_maps_collective_timeout():
     from horovod_tpu.runner.config_parser import _ARG_ENV
 
     assert _ARG_ENV["collective_timeout"] == env_util.COLLECTIVE_TIMEOUT
+
+
+def test_cli_shm_knob_validation():
+    """--shm-slot-bytes below the 4 KiB floor is a parse-time error (rc
+    2) that points at --no-shm, before any worker is launched."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.run",
+         "-np", "2", "--shm-slot-bytes", "100",
+         sys.executable, "-c", "pass"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    assert res.returncode == 2, (res.stdout, res.stderr)
+    assert "--shm-slot-bytes" in res.stderr, res.stderr
+    assert "--no-shm" in res.stderr, res.stderr
+
+
+def test_config_parser_maps_shm_knobs():
+    from horovod_tpu.runner.config_parser import _ARG_ENV, _BOOL
+
+    assert _ARG_ENV["no_shm"] == env_util.SHM_DISABLE
+    assert _ARG_ENV["shm_slot_bytes"] == env_util.SHM_SLOT_BYTES
+    assert _ARG_ENV["shm_slots"] == env_util.SHM_SLOTS
+    assert "no_shm" in _BOOL  # store_true flag, maps to "1" not "True"

@@ -73,8 +73,11 @@ def main():
             if victim and state.step == VICTIM_STEP:
                 # Wedge this rank's next ring-hop receive, in-process
                 # (no `after` counting against bootstrap collectives).
+                # TIMEOUT_SITE picks the transport being stalled:
+                # sock.stall (TCP, the default) or shm.stall.
+                site = os.environ.get("TIMEOUT_SITE", "sock.stall")
                 fi.configure({"faults": [
-                    {"site": "sock.stall", "kind": "stall",
+                    {"site": site, "kind": "stall",
                      "stall_s": 600}]})
             t0 = time.monotonic()
             try:
