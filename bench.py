@@ -458,6 +458,94 @@ def main() -> None:
     except Exception as e:
         extras["decode_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # --- decode per-token latency: the serving step -----------------------
+    # Percentiles of a single batched decode_step (serving/decode.py) —
+    # the latency a served token actually pays, where the throughput
+    # number above amortizes prefill over the whole generation.
+    try:
+        from horovod_tpu.serving.decode import DecodeEngine
+
+        deng = DecodeEngine(gparams, gcfg, max_batch=gbatch,
+                            cache_len=gcfg.max_seq_len)
+        for slot in range(gbatch):
+            deng.prefill(slot, [1 + slot, 7, 11, 13])
+        for _ in range(3):
+            deng.step()  # warmup (np.asarray inside fences the device)
+        lats = []
+        for _ in range(40):
+            t0 = time.perf_counter()
+            deng.step()
+            lats.append((time.perf_counter() - t0) * 1e3)
+        for q in (50, 90, 99):
+            extras[f"decode_token_latency_p{q}_ms"] = round(
+                float(np.percentile(lats, q)), 3)
+    except Exception as e:
+        extras["decode_latency_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- serving: closed-loop clients vs the in-process loop --------------
+    # The full serving stack — FrontDoor HTTP, bounded-queue scheduler,
+    # continuous-batching ServingLoop — single-rank in this process,
+    # measured the way an SLO is: concurrent closed-loop clients, wall
+    # time per request (docs/serving.md).
+    try:
+        import http.client
+        import threading as _th
+
+        from horovod_tpu.serving import ServingLoop
+
+        ready = _th.Event()
+        box = {}
+
+        def _on_ready(port):
+            box["port"] = port
+            ready.set()
+
+        sloop = ServingLoop(gparams, gcfg, port=0, max_batch=4,
+                            max_queue=64, cache_len=gcfg.max_seq_len,
+                            host="127.0.0.1", on_ready=_on_ready)
+        sthread = _th.Thread(target=sloop.run, daemon=True)
+        sthread.start()
+        if not ready.wait(120):
+            raise TimeoutError("serving loop never came up")
+        n_clients, reqs_each, snew = 3, 5, 16
+        lat_ms, ttft_ms = [], []
+        lk = _th.Lock()
+
+        def _client(ci):
+            for j in range(reqs_each):
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", box["port"], timeout=120)
+                t0 = time.perf_counter()
+                conn.request("POST", "/generate", json.dumps(
+                    {"prompt": [1 + 7 * ci + j, 5, 9],
+                     "max_new_tokens": snew}))
+                body = json.loads(conn.getresponse().read())
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                conn.close()
+                with lk:
+                    lat_ms.append(dt_ms)
+                    if body.get("ttft_ms") is not None:
+                        ttft_ms.append(body["ttft_ms"])
+
+        cts = [_th.Thread(target=_client, args=(ci,))
+               for ci in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in cts:
+            t.start()
+        for t in cts:
+            t.join()
+        wall = time.perf_counter() - t0
+        sloop.stop()
+        sthread.join(30)
+        extras["serve_tokens_per_sec"] = round(
+            n_clients * reqs_each * snew / wall, 1)
+        extras["serve_ttft_p50_ms"] = round(
+            float(np.percentile(ttft_ms, 50)), 2)
+        extras["serve_p99_ms"] = round(
+            float(np.percentile(lat_ms, 99)), 2)
+    except Exception as e:
+        extras["serve_bench_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # --- eager data plane: fused-small-tensor rate ----------------------
     # A real 2-rank Python-engine gang over the host TCP mesh (run-func
     # mode — same launch path as examples/engine_benchmark.py), timing

@@ -7,9 +7,11 @@
 //
 // The Python engine additionally defines collective-abort agreement
 // payloads (AbortReport / ProbeAck / AbortVerdict, wire.py) carried on
-// reserved control tags 6-9 (sockets.h).  They have no C++ mirror: the
-// native engine ignores HVD_COLLECTIVE_TIMEOUT — the knob only takes
-// effect on PyEngine gangs (runtime_py.py).
+// reserved control tags 6-9 (sockets.h), and the serving admission
+// broadcast (ServeDelta, wire.py) on reserved tag 10.  They have no C++
+// mirror: the native engine ignores HVD_COLLECTIVE_TIMEOUT and never
+// hosts horovod_tpu.serving — both only take effect on PyEngine gangs
+// (runtime_py.py).
 #pragma once
 
 #include <cstdint>

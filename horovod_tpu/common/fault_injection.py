@@ -86,6 +86,8 @@ KNOWN_SITES = {
     "shm.stall": "data-plane shm ring receive (hang simulation)",
     "shm.attach": "shm segment attach during transport pairing",
     "train.step": "user-level per-step site (training scripts)",
+    "serve.admit": "serving front-door admission (HTTP 503 shedding)",
+    "serve.step": "serving decode step, every rank (stall/delay sim)",
     # data plane (should_corrupt)
     "grad.nonfinite": "poison local gradients with NaN (eager guard)",
     "state.bitflip": "flip one bit of the audited replica state",

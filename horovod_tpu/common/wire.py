@@ -386,3 +386,47 @@ def decode_abort_verdict(data: bytes) -> Tuple[str, List[int], int]:
         ranks.append(r)
     (epoch,) = struct.unpack_from("<I", data, off)
     return name, ranks, epoch
+
+
+# -- serving admission broadcast (docs/serving.md) ----------------------
+#
+# One frame per continuous-batching decode step: rank 0's scheduler tells
+# the gang which requests join which slots THIS step.  Retirements are
+# not carried — decode is deterministic (greedy), so every rank retires
+# the same slot at the same token on its own.
+
+
+def encode_serve_delta(seq: int, stop: bool, admissions,
+                       epoch: int = 0) -> bytes:
+    """Coordinator -> workers: step ``seq``'s batch delta.
+    ``admissions``: iterable of (slot, request_id, max_new_tokens,
+    prompt_tokens) with ``prompt_tokens`` an iterable of ints."""
+    buf = bytearray()
+    buf += struct.pack("<QBI", seq, 1 if stop else 0, len(admissions))
+    for slot, req_id, max_new, prompt in admissions:
+        buf += struct.pack("<II", slot, max_new)
+        _pack_str(buf, req_id)
+        prompt = [int(t) for t in prompt]
+        buf += struct.pack(f"<I{len(prompt)}I", len(prompt), *prompt)
+    buf += struct.pack("<I", epoch)
+    return bytes(buf)
+
+
+def decode_serve_delta(data: bytes):
+    """Returns (seq, stop, admissions, epoch) — the encode_serve_delta
+    arguments, with each admission as (slot, request_id, max_new_tokens,
+    prompt_tokens list)."""
+    seq, stop, n = struct.unpack_from("<QBI", data, 0)
+    off = struct.calcsize("<QBI")
+    admissions = []
+    for _ in range(n):
+        slot, max_new = struct.unpack_from("<II", data, off)
+        off += 8
+        req_id, off = _unpack_str(data, off)
+        (plen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        prompt = list(struct.unpack_from(f"<{plen}I", data, off))
+        off += 4 * plen
+        admissions.append((slot, req_id, max_new, prompt))
+    (epoch,) = struct.unpack_from("<I", data, off)
+    return seq, bool(stop), admissions, epoch

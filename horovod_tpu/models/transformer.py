@@ -434,10 +434,16 @@ def _prefill(params, tokens, cfg, Smax):
 
 def generate(params, prompt, cfg: TransformerConfig, *,
              max_new_tokens: int, temperature: float = 0.0,
-             rng=None):
+             rng=None, cache_len: Optional[int] = None):
     """Autoregressive decode.  ``prompt``: [B, S0] int32.  Returns
     [B, S0 + max_new_tokens] (prompt + generated).  ``temperature=0``
     is greedy argmax; otherwise softmax sampling with ``rng``.
+
+    ``cache_len`` pins the KV-cache length (default: exactly
+    ``S0 + max_new_tokens``).  The extra positions are masked out, but
+    the cache length still shapes XLA's reduction tree — callers that
+    compare against a fixed-length serving cache (serving/decode.py)
+    pass the serving length here to keep the comparison bit-exact.
 
     Dense-FFN configs only (``n_experts=0``) — MoE routing under a
     one-token capacity is a different decode design.
@@ -457,6 +463,12 @@ def generate(params, prompt, cfg: TransformerConfig, *,
         raise ValueError(
             f"prompt + new tokens ({Smax}) exceeds max_seq_len "
             f"({cfg.max_seq_len})")
+    if cache_len is not None:
+        if cache_len < Smax:
+            raise ValueError(
+                f"cache_len ({cache_len}) is shorter than prompt + new "
+                f"tokens ({Smax})")
+        Smax = cache_len
     dtype = cfg.compute_dtype
     logits0, ks, vs = _prefill(params, prompt, cfg, Smax)
     if rng is None:
@@ -495,3 +507,96 @@ def generate(params, prompt, cfg: TransformerConfig, *,
     out = jnp.concatenate(
         [prompt, first[:, None], rest.swapaxes(0, 1)], axis=1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching decode (horovod_tpu.serving)
+# ---------------------------------------------------------------------------
+#
+# generate() decodes one request at a time: every row of the batch shares a
+# single scalar position.  A serving batch is ragged — each slot joined at
+# a different step and sits at its own offset in the KV cache — so these
+# entry points carry a per-slot position VECTOR.  The per-row math is that
+# of _attention_cached exactly (same einsums, same mask construction, same
+# f32 softmax), which is what keeps a continuously batched decode
+# bit-identical to the single-request generate() oracle: rows never mix,
+# so a slot's output depends only on its own cache lane.
+
+
+KV_CACHE_SPEC = P(None, None, None, "tp", None)  # [L, B, Smax, H, HD]
+
+
+def _attention_cached_slots(x, lp, cfg, k_cache, v_cache, pos):
+    """One token per slot against the cache, at per-slot positions.
+
+    x: [B, 1, D]; k/v_cache: [B, Smax, H, HD]; ``pos``: [B] int32, the
+    absolute position of THIS token in each slot.  Returns
+    (out [B, 1, D], updated caches)."""
+    dtype = cfg.compute_dtype
+    B = x.shape[0]
+    q = jnp.einsum("bsd,dhk->bshk", x, lp["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dhk->bshk", x, lp["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dhk->bshk", x, lp["wv"].astype(dtype))
+    # _rope with a per-row angle: ang[b] = pos[b] * freqs — the scalar-pos
+    # rotation of _attention_cached applied row-wise.
+    half = cfg.head_dim // 2
+    freqs = jnp.exp(
+        -math.log(cfg.rope_theta)
+        * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]   # [B, half]
+    cos = jnp.cos(ang)[:, None, None, :]
+    sin = jnp.sin(ang)[:, None, None, :]
+
+    def rot(t):
+        t1, t2 = t[..., :half], t[..., half:]
+        tf1, tf2 = t1.astype(jnp.float32), t2.astype(jnp.float32)
+        return jnp.concatenate(
+            [tf1 * cos - tf2 * sin, tf2 * cos + tf1 * sin], axis=-1
+        ).astype(t.dtype)
+
+    q = rot(q)
+    k = rot(k)
+    rows = jnp.arange(B)
+    k_cache = k_cache.at[rows, pos].set(k[:, 0])
+    v_cache = v_cache.at[rows, pos].set(v[:, 0])
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = jnp.einsum("bshk,bthk->bhst", q, k_cache
+                        ).astype(jnp.float32) * scale
+    Smax = k_cache.shape[1]
+    valid = jnp.arange(Smax)[None, :] <= pos[:, None]         # [B, Smax]
+    logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    ctx = jnp.einsum("bhst,bthk->bshk", probs, v_cache)
+    return (jnp.einsum("bshk,hkd->bsd", ctx, lp["wo"].astype(dtype)),
+            k_cache, v_cache)
+
+
+def decode_step(params, tok, pos, ks, vs, cfg: TransformerConfig):
+    """One continuous-batching step: embed ``tok`` [B], attend each slot
+    at its own ``pos`` [B], return (next-token logits [B, V] f32, updated
+    caches [L, B, Smax, H, HD]).  The layer body is generate()'s step
+    with _attention_cached swapped for the per-slot-position variant.
+    Dense-FFN configs only (same contract as generate())."""
+    dtype = cfg.compute_dtype
+    x = params["embed"].astype(dtype)[tok[:, None]]
+
+    def layer(h, layer_in):
+        lp, k_c, v_c = layer_in
+        y = _rmsnorm(h, lp["ln1"])
+        attn, k_c, v_c = _attention_cached_slots(y, lp, cfg, k_c, v_c, pos)
+        h = h + attn
+        h = h + _dense_ffn(_rmsnorm(h, lp["ln2"]), lp, dtype)
+        return h, (k_c, v_c)
+
+    x, (ks, vs) = lax.scan(layer, x, (params["layers"], ks, vs))
+    x = _rmsnorm(x, params["ln_f"])
+    logits = vocab_projection(x, params["embed"])[:, 0]
+    return logits, ks, vs
+
+
+def prefill_request(params, prompt, cfg: TransformerConfig, cache_len: int):
+    """Prefill ONE request.  ``prompt``: [S0] int32.  Returns
+    (next-token logits [V] f32, per-layer K/V [L, 1, cache_len, H, HD])
+    ready to be written into a serving batch's slot lane."""
+    logits, ks, vs = _prefill(params, prompt[None], cfg, cache_len)
+    return logits[0], ks, vs

@@ -179,6 +179,16 @@ def filter_spec(spec, mesh):
     return P(*[keep(ax) for ax in spec])
 
 
+def sharding_for(mesh, spec):
+    """``NamedSharding`` for ``spec`` on ``mesh`` with axes the mesh
+    doesn't carry dropped (``filter_spec``) — the one-liner every
+    consumer of a full-vocabulary spec ends up writing (e.g. the serving
+    KV caches, serving/decode.py)."""
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, filter_spec(spec, mesh))
+
+
 def data_parallel_axes(mesh) -> Tuple[str, ...]:
     """Axes that carry gradient reduction: every mesh axis that is a
     replication axis for parameters (dp, dcn and ep-for-non-expert params

@@ -38,6 +38,9 @@ _ARG_ENV = {
     "max_np": E.ELASTIC_MAX_NP,
     "host_discovery_script": E.HOST_DISCOVERY_SCRIPT,
     "metrics_port": E.METRICS_PORT,
+    "serve_port": E.SERVE_PORT,
+    "serve_max_batch": E.SERVE_MAX_BATCH,
+    "serve_max_queue": E.SERVE_MAX_QUEUE,
 }
 
 _MB = {"fusion_threshold_mb"}

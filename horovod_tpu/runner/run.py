@@ -271,6 +271,18 @@ def make_parser() -> argparse.ArgumentParser:
                          "(worker binds port + local_rank; "
                          "see docs/metrics.md)")
 
+    sv = p.add_argument_group("serving")
+    sv.add_argument("--serve-port", type=int, dest="serve_port",
+                    help="rank-0 inference front-door port for "
+                         "horovod_tpu.serving workloads "
+                         "(see docs/serving.md)")
+    sv.add_argument("--serve-max-batch", type=int, dest="serve_max_batch",
+                    help="decode slots per serving batch "
+                         "(continuous-batching width)")
+    sv.add_argument("--serve-max-queue", type=int, dest="serve_max_queue",
+                    help="admission queue bound; beyond it the front "
+                         "door sheds with HTTP 503")
+
     p.add_argument("--log-level", dest="log_level",
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -326,6 +338,17 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
               f"(got {args.metrics_port}); each worker binds "
               "metrics-port + local_rank", file=sys.stderr)
         return 2
+    if args.serve_port is not None and \
+            not (1 <= args.serve_port <= 65535):
+        print(f"{_prog_name()}: --serve-port must be in 1..65535 "
+              f"(got {args.serve_port})", file=sys.stderr)
+        return 2
+    for flag, val in (("--serve-max-batch", args.serve_max_batch),
+                      ("--serve-max-queue", args.serve_max_queue)):
+        if val is not None and val < 1:
+            print(f"{_prog_name()}: {flag} must be >= 1 (got {val})",
+                  file=sys.stderr)
+            return 2
     for flag, val in (("--ring-segment-bytes", args.ring_segment_bytes),
                       ("--sock-buf-bytes", args.sock_buf_bytes),
                       ("--collective-timeout", args.collective_timeout)):

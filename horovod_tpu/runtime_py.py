@@ -258,9 +258,40 @@ class SingleProcessEngine(_EngineBase):
         super().__init__(0, 1, 0, 1, 0, 1)
         self.timeline = timeline_mod.from_env(0)
         _telemetry.init_from_env(0, 0)
+        # Serving surface (serving/loop.py): a broadcast to a gang of
+        # one is a local enqueue, so the loop's drive/apply split works
+        # unchanged single-process.
+        self.epoch = 0
+        self._aborted = False
+        self._serve_inbox: List[bytes] = []
+        self._serve_cv = threading.Condition()
+        self._shutdown_requested = threading.Event()
+        self._shutdown_flag = threading.Event()
 
     def shutdown(self):
+        self._shutdown_flag.set()
+        with self._serve_cv:
+            self._serve_cv.notify_all()
         self.timeline.shutdown()
+
+    def serve_broadcast(self, payload: bytes) -> None:
+        with self._serve_cv:
+            self._serve_inbox.append(payload)
+            self._serve_cv.notify_all()
+
+    def serve_recv(self, timeout: float) -> Optional[bytes]:
+        deadline = time.monotonic() + timeout
+        with self._serve_cv:
+            while True:
+                if self._serve_inbox:
+                    return self._serve_inbox.pop(0)
+                if self._shutdown_flag.is_set() \
+                        or self._shutdown_requested.is_set():
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._serve_cv.wait(min(0.05, remaining))
 
     def _finish(self, name, op_name, result):
         self.timeline.negotiate_start(name, op_name)
@@ -435,10 +466,17 @@ class PyEngine(_EngineBase):
         self.collective_probe_timeout = env_util.get_float(
             env_util.COLLECTIVE_PROBE_TIMEOUT,
             max(0.5, self.collective_timeout / 2.0))
-        # Worker ctrl sends happen on the background thread (_worker_
-        # cycle) AND the recv thread (probe acks); serialize so frames
-        # never interleave.
+        # Ctrl sends can happen off the background thread on both sides:
+        # workers send from _worker_cycle AND the recv thread (probe
+        # acks); the coordinator sends from the background thread AND
+        # the serving loop's thread (TAG_SERVE admission broadcasts).
+        # Serialize so frames never interleave.
         self._ctrl_send_lock = threading.Lock()
+        # Serving admission broadcast (TAG_SERVE): frames land here on
+        # every rank (the coordinator delivers to itself directly) and
+        # the serving loop drains them via serve_recv().
+        self._serve_inbox: List[bytes] = []
+        self._serve_cv = threading.Condition()
         # Coordinator: reports/acks captured by the ctrl recv threads.
         self._abort_inbox: List[tuple] = []
         self._abort_lock = threading.Lock()
@@ -582,8 +620,54 @@ class PyEngine(_EngineBase):
                     with self._abort_cv:
                         self._abort_verdict = (vname, vranks)
                         self._abort_cv.notify_all()
+                elif tag == su.TAG_SERVE:
+                    with self._serve_cv:
+                        self._serve_inbox.append(payload)
+                        self._serve_cv.notify_all()
         except (ConnectionError, OSError):
             pass
+
+    # -- serving admission broadcast (docs/serving.md) -------------------
+
+    def serve_broadcast(self, payload: bytes) -> None:
+        """Coordinator: push one serve-step frame (wire.py ServeDelta) to
+        every live worker and to the local inbox.  Called from the
+        serving loop's thread, hence the ctrl send lock."""
+        if self.rank != 0:
+            raise RuntimeError("serve_broadcast is coordinator-only")
+        for r, s in self._ctrl_socks.items():
+            if r in self._evicted_ranks:
+                continue
+            try:
+                with self._ctrl_send_lock:
+                    su.send_frame(s, su.TAG_SERVE, payload)
+            except (ConnectionError, OSError):
+                pass  # liveness machinery owns the eviction
+        with self._serve_cv:
+            self._serve_inbox.append(payload)
+            self._serve_cv.notify_all()
+
+    def serve_recv(self, timeout: float) -> Optional[bytes]:
+        """Block (≤ ``timeout`` s) for the next serve-step frame.  None
+        on timeout or local shutdown; raises RanksFailedError once peers
+        have been declared failed so the serving loop re-forms through
+        the same path as a failed collective."""
+        deadline = time.monotonic() + timeout
+        with self._serve_cv:
+            while True:
+                if self._serve_inbox:
+                    return self._serve_inbox.pop(0)
+                if self._ranks_failed:
+                    raise RanksFailedError(self._ranks_failed)
+                if self._aborted or self._shutdown_flag.is_set() \
+                        or self._shutdown_requested.is_set():
+                    return None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                # Short slices: the cv is only notified on frame arrival,
+                # and abort/shutdown must still wake this thread.
+                self._serve_cv.wait(min(0.05, remaining))
 
     # ------------------------------------------------------------------
     # enqueue API (framework-thread side)
@@ -1209,7 +1293,8 @@ class PyEngine(_EngineBase):
                     payload = shared
                 try:
                     _fi.fire("ctrl.coord.send", str(r))
-                    su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
+                    with self._ctrl_send_lock:
+                        su.send_frame(s, su.TAG_RESPONSE_LIST, payload)
                 except (ConnectionError, OSError):
                     pass
             if params is not None:
@@ -1341,9 +1426,10 @@ class PyEngine(_EngineBase):
         if sock is None:
             return
         try:
-            su.send_frame(
-                sock, su.TAG_ABORT_VERDICT,
-                wire.encode_abort_verdict(vname, vranks, self.epoch))
+            with self._ctrl_send_lock:
+                su.send_frame(
+                    sock, su.TAG_ABORT_VERDICT,
+                    wire.encode_abort_verdict(vname, vranks, self.epoch))
         except (ConnectionError, OSError):
             pass
 
@@ -1367,7 +1453,9 @@ class PyEngine(_EngineBase):
         def _probe() -> None:
             for r in live:
                 try:
-                    su.send_frame(self._ctrl_socks[r], su.TAG_PROBE, b"")
+                    with self._ctrl_send_lock:
+                        su.send_frame(self._ctrl_socks[r],
+                                      su.TAG_PROBE, b"")
                 except (ConnectionError, OSError):
                     pass
 
@@ -1428,8 +1516,9 @@ class PyEngine(_EngineBase):
         self._last_verdict = (name, wedged)
         for r in live:
             try:
-                su.send_frame(self._ctrl_socks[r],
-                              su.TAG_ABORT_VERDICT, payload)
+                with self._ctrl_send_lock:
+                    su.send_frame(self._ctrl_socks[r],
+                                  su.TAG_ABORT_VERDICT, payload)
             except (ConnectionError, OSError):
                 pass
         self._apply_abort_verdict(name, wedged, t0)

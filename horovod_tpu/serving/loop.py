@@ -1,0 +1,283 @@
+"""The gang-wide serving loop: lockstep continuous-batching decode.
+
+One :class:`ServingLoop` runs on every rank (``run()`` blocks for the
+life of the deployment).  Rank 0 drives: it drains the scheduler's
+admission queue into free slots at each token boundary, encodes the
+batch delta as one ``TAG_SERVE`` frame (common/wire.py ServeDelta) and
+pushes it to every rank over the control channel
+(``runtime_py.serve_broadcast``) — including itself, so coordinator and
+workers execute the identical ``_apply_frame`` path.  Every rank then
+prefills the admitted prompts, steps the shared jit-ed decode function,
+and retires finished slots.  Greedy decode is deterministic, so
+retirements need no broadcast: every rank computes the same tokens and
+drops the same slots.
+
+Robustness is composed from the existing machinery, not rebuilt:
+
+* Each step ends in a tiny token-agreement allreduce
+  (``__serve.confirm``, MAX over the next-token vector).  That gives the
+  PR-6 collective deadline a data-plane op to bound — a rank wedged in
+  the ring trips the hop deadline, the gang-wide abort agreement names
+  it, and the survivors raise :class:`CollectiveTimeoutError` out of
+  this loop.  It also feeds the per-step straggler detector (a
+  chaos-delayed rank is consistently last into the negotiation and gets
+  a STRAGGLER timeline record), and doubles as a determinism check:
+  if any rank's tokens differ from the gang max, greedy lockstep has
+  diverged and the step fails loudly rather than serving garbage.
+* The epoch body is wrapped in ``@hvd.elastic.run``: on an abort the
+  gang re-forms in process, a fresh :class:`DecodeEngine` is built
+  against the new world, and rank 0 requeues every in-flight request at
+  the front of the queue (``Scheduler.requeue_inflight``) — requests are
+  replayed from their prompts, at-least-once, to the bit-identical
+  completion (greedy).  The HTTP front door and its parked handler
+  threads belong to the process, so clients only observe added latency.
+
+A rank that stalls *outside* the data plane (``serve.step`` chaos site,
+kind=stall) is invisible to the collective deadline — it never submits,
+so there is no hung collective to abort, only the coordinator's stalled-
+tensor warnings (see docs/serving.md for why that distinction matters).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.common import wire
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.serving.decode import DecodeEngine
+from horovod_tpu.serving.scheduler import Scheduler
+from horovod_tpu.serving.server import FrontDoor
+from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils.logging import get_logger
+
+
+class ServingLoop:
+    """Continuous-batching inference over the live gang.
+
+    ``run()`` initializes (if needed), starts the rank-0 front door, and
+    blocks serving until ``stop()`` — surviving rank failures via
+    elastic re-forms along the way.  Knobs default from the
+    ``HVD_SERVE_*`` environment (utils/env.py; set by ``hvdrun
+    --serve-port/--serve-max-batch/--serve-max-queue``).
+    """
+
+    def __init__(self, params, cfg, *,
+                 max_batch: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 port: Optional[int] = None,
+                 host: str = "0.0.0.0",
+                 cache_len: Optional[int] = None,
+                 mesh=None,
+                 eos_id: Optional[int] = None,
+                 request_timeout_s: float = 120.0,
+                 recv_timeout_s: float = 1.0,
+                 idle_poll_s: float = 0.002,
+                 on_ready: Optional[Callable[[int], None]] = None):
+        self.params = params
+        self.cfg = cfg
+        self.max_batch = max_batch or env_util.serve_max_batch()
+        self.max_queue = max_queue or env_util.serve_max_queue()
+        self.port = env_util.serve_port() if port is None else port
+        self.host = host
+        self.cache_len = cache_len or cfg.max_seq_len
+        self.mesh = mesh
+        self.eos_id = eos_id
+        self.request_timeout_s = request_timeout_s
+        self.recv_timeout_s = recv_timeout_s
+        self.idle_poll_s = idle_poll_s
+        self.on_ready = on_ready
+        self.scheduler: Optional[Scheduler] = None
+        self._door: Optional[FrontDoor] = None
+        self._stop = threading.Event()
+        # slot -> {"id": request id, "remaining": tokens still owed}.
+        # Rebuilt from scratch each epoch; every rank derives the same
+        # dict from the same frame stream.
+        self._slots: Dict[int, Dict] = {}
+        self.log = get_logger(0)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def stop(self) -> None:
+        """Ask the loop to drain and exit: rank 0 finishes every queued
+        and active request, then broadcasts a stop frame."""
+        self._stop.set()
+
+    def run(self) -> None:
+        """Serve until ``stop()``.  Blocks; re-forms the gang in process
+        on rank failure (``@hvd.elastic.run`` semantics)."""
+        from horovod_tpu import basics, elastic
+
+        os.environ.setdefault("HVD_TPU_CORE", "py")
+        if not basics.is_initialized():
+            basics.init()
+        try:
+            if basics.size() == 1 and \
+                    not os.environ.get("HVD_RENDEZVOUS_ADDR"):
+                # Single process, no launcher: there is no gang to
+                # re-form (and no KV store for the elastic protocol),
+                # so run one incarnation directly.
+                import types
+
+                self._epoch_body(types.SimpleNamespace(
+                    serve_generation=0))
+            else:
+                state = elastic.ObjectState(serve_generation=0)
+                elastic.run(self._epoch_body)(state)
+        finally:
+            if self._door is not None:
+                self._door.stop()
+                self._door = None
+            if self.scheduler is not None:
+                self.scheduler.fail_all("serving loop exited")
+
+    # -- one gang incarnation -------------------------------------------
+
+    def _epoch_body(self, state) -> None:
+        from horovod_tpu import basics
+
+        eng = basics._runtime
+        if eng is None or not hasattr(eng, "serve_broadcast"):
+            raise RuntimeError(
+                "serving requires the Python engine (HVD_TPU_CORE=py)")
+        self.log = get_logger(basics.rank())
+        engine = DecodeEngine(self.params, self.cfg,
+                              max_batch=self.max_batch,
+                              cache_len=self.cache_len, mesh=self.mesh)
+        self._slots = {}
+        if basics.rank() == 0:
+            state.serve_generation += 1
+            self._ensure_front_door()
+            replayed = self.scheduler.requeue_inflight()
+            if replayed:
+                self.log.info(
+                    "re-formed gang (generation %d): replaying %d "
+                    "in-flight request(s) from their prompts",
+                    state.serve_generation, replayed)
+            self._drive(eng, engine)
+        else:
+            self._follow(eng, engine)
+
+    def _ensure_front_door(self) -> None:
+        """Create the scheduler/front door once per process — also on a
+        worker promoted to rank 0 by a re-form (its door binds a fresh
+        port; in-flight state died with the old rank 0)."""
+        if self.scheduler is None:
+            self.scheduler = Scheduler(self.max_batch, self.max_queue,
+                                       self.cache_len)
+        if self._door is None:
+            self._door = FrontDoor(self.scheduler, host=self.host,
+                                   port=self.port,
+                                   timeout_s=self.request_timeout_s)
+            self.port = self._door.start()
+            self.log.info("serving front door listening on :%d",
+                          self.port)
+            if self.on_ready is not None:
+                self.on_ready(self.port)
+
+    # -- rank 0: drive ---------------------------------------------------
+
+    def _drive(self, eng, engine: DecodeEngine) -> None:
+        seq = 0
+        while True:
+            stopping = self._stop.is_set() and not self.scheduler.has_work()
+            admissions = self.scheduler.take_admissions()
+            if not stopping and not admissions and not self._slots:
+                time.sleep(self.idle_poll_s)  # idle: no frame, no step
+                continue
+            seq += 1
+            payload = wire.encode_serve_delta(
+                seq, stopping,
+                [(slot, r.id, r.max_new, r.prompt)
+                 for slot, r in admissions],
+                eng.epoch)
+            eng.serve_broadcast(payload)
+            frame = eng.serve_recv(timeout=self.recv_timeout_s)
+            if frame is None:  # own frame is in the inbox unless dying
+                if self._engine_dying(eng):
+                    return
+                continue
+            if self._apply_frame(frame, eng, engine, rank0=True):
+                return
+
+    # -- workers: follow -------------------------------------------------
+
+    def _follow(self, eng, engine: DecodeEngine) -> None:
+        while True:
+            frame = eng.serve_recv(timeout=self.recv_timeout_s)
+            if frame is None:
+                if self._engine_dying(eng):
+                    return
+                continue  # plain timeout: keep listening
+            if self._apply_frame(frame, eng, engine, rank0=False):
+                return
+
+    @staticmethod
+    def _engine_dying(eng) -> bool:
+        """None from serve_recv: timeout (keep going), clean shutdown
+        (exit), or abort.  A lost-coordinator abort is re-raised as the
+        RuntimeError the elastic wrapper maps to a rank-0 failure."""
+        if getattr(eng, "_aborted", False):
+            raise RuntimeError(
+                getattr(eng, "_abort_reason", None) or "engine aborted")
+        return eng._shutdown_flag.is_set() or \
+            eng._shutdown_requested.is_set()
+
+    # -- the lockstep step (identical on every rank) ---------------------
+
+    def _apply_frame(self, frame, eng, engine, *, rank0: bool) -> bool:
+        seq, stopping, admissions, epoch = wire.decode_serve_delta(frame)
+        if epoch != eng.epoch:
+            return False  # stale frame from a previous incarnation
+        if stopping:
+            return True
+        # Chaos: a mid-decode stall/delay on this rank, fired before any
+        # device work so the step's collective shows the gap.
+        _fi.fire("serve.step", str(seq))
+        t0 = time.monotonic()
+        for slot, req_id, max_new, prompt in admissions:
+            first = engine.prefill(slot, prompt)
+            self._slots[slot] = {"id": req_id, "remaining": max_new}
+            self._emit(slot, first, engine, rank0)
+        if self._slots:
+            toks = engine.step()
+            self._confirm(toks)
+            for slot in sorted(self._slots):
+                self._emit(slot, int(toks[slot]), engine, rank0)
+            if rank0:
+                _tmx.observe("hvd_serve_token_latency_seconds",
+                             time.monotonic() - t0)
+        return False
+
+    def _emit(self, slot: int, token: int, engine: DecodeEngine,
+              rank0: bool) -> None:
+        st = self._slots[slot]
+        if rank0:
+            self.scheduler.on_token(slot, token)
+        st["remaining"] -= 1
+        if st["remaining"] <= 0 or \
+                (self.eos_id is not None and token == self.eos_id):
+            engine.clear(slot)
+            del self._slots[slot]
+            if rank0:
+                self.scheduler.complete(slot)
+
+    def _confirm(self, toks: np.ndarray) -> None:
+        """Token-agreement allreduce: the step's data-plane op (deadline
+        + straggler surface) and the greedy-lockstep determinism check."""
+        from horovod_tpu.ops import eager
+
+        local = np.asarray(toks, dtype=np.float64)
+        agreed = eager.allreduce(local, op=ReduceOp.MAX,
+                                 name="__serve.confirm")
+        if not np.array_equal(np.asarray(agreed), local):
+            raise RuntimeError(
+                "serving token divergence: this rank's greedy tokens "
+                "differ from the gang's — lockstep decode is broken "
+                "(non-deterministic kernels or mismatched params?)")

@@ -1,0 +1,186 @@
+"""Continuous-batching admission scheduler (rank 0 only).
+
+The scheduler owns the request lifecycle on the coordinator: HTTP
+handler threads ``submit()`` prompts into a bounded FIFO queue, the
+serving loop moves queued requests into free decode slots at token
+boundaries (``take_admissions``), appends sampled tokens
+(``on_token``), and completes or replays them.  Worker ranks never see
+this class — they reconstruct identical slot state from the broadcast
+deltas (loop.py).
+
+Thread-safety: handler threads and the serving-loop thread share one
+lock; completion is signalled per-request through an Event the handler
+blocks on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.telemetry import registry as _tmx
+
+
+class QueueFull(Exception):
+    """Admission queue is at HVD_SERVE_MAX_QUEUE — shed (HTTP 503)."""
+
+
+class Request:
+    """One /generate request through its life: queued -> active (slot
+    assigned) -> done.  ``tokens`` holds only the generated tail, never
+    the prompt."""
+
+    def __init__(self, req_id: str, prompt: List[int], max_new: int):
+        self.id = req_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+        self.t_submit = time.monotonic()
+        self.t_first_token: Optional[float] = None
+        # Bumped on each replay admission: a re-formed gang decodes the
+        # request from the prompt again (at-least-once), so the token
+        # tail is rebuilt from scratch.
+        self.attempts = 0
+
+
+class Scheduler:
+    def __init__(self, max_batch: int, max_queue: int, cache_len: int):
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.cache_len = cache_len
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+        self._slots: List[Optional[Request]] = [None] * max_batch
+        self._ids = itertools.count()
+        self._completed = 0
+
+    # -- handler-thread side -------------------------------------------
+
+    def submit(self, prompt: List[int], max_new: int) -> Request:
+        """Queue a request; raises ValueError on an unservable shape and
+        QueueFull when the admission queue is at its bound."""
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new > self.cache_len:
+            raise ValueError(
+                f"prompt + max_new_tokens ({len(prompt) + max_new}) "
+                f"exceeds the serving cache length ({self.cache_len})")
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    f"admission queue full ({self.max_queue})")
+            req = Request(f"r{next(self._ids)}", list(prompt), max_new)
+            self._queue.append(req)
+            _tmx.set_gauge("hvd_serve_queue_depth", len(self._queue))
+        return req
+
+    # -- serving-loop side ---------------------------------------------
+
+    def take_admissions(self) -> List[Tuple[int, Request]]:
+        """Move queued requests into free slots (FIFO, as many as fit);
+        returns the (slot, request) pairs admitted this step."""
+        out: List[Tuple[int, Request]] = []
+        with self._lock:
+            for slot in range(self.max_batch):
+                if self._slots[slot] is not None or not self._queue:
+                    continue
+                req = self._queue.popleft()
+                req.slot = slot
+                req.attempts += 1
+                self._slots[slot] = req
+                out.append((slot, req))
+            if out:
+                _tmx.set_gauge("hvd_serve_queue_depth", len(self._queue))
+                _tmx.set_gauge("hvd_serve_batch_occupancy",
+                               self.active_count())
+        return out
+
+    def on_token(self, slot: int, token: int) -> Request:
+        """Append one sampled token to the slot's request (first token
+        records TTFT)."""
+        with self._lock:
+            req = self._slots[slot]
+            assert req is not None, f"token for empty slot {slot}"
+            if not req.tokens:
+                req.t_first_token = time.monotonic()
+                _tmx.observe("hvd_serve_ttft_seconds",
+                             req.t_first_token - req.t_submit)
+            req.tokens.append(token)
+        return req
+
+    def complete(self, slot: int) -> None:
+        """Retire the slot's request and wake its handler thread."""
+        with self._lock:
+            req = self._slots[slot]
+            assert req is not None, f"complete() on empty slot {slot}"
+            self._slots[slot] = None
+            self._completed += 1
+            _tmx.set_gauge("hvd_serve_batch_occupancy",
+                           self.active_count())
+        _tmx.inc_counter("hvd_serve_requests_total", labels=("ok",))
+        req.done.set()
+
+    def requeue_inflight(self) -> int:
+        """At-least-once replay after a gang re-form: every active
+        request goes back to the FRONT of the queue (original admission
+        order) with its token tail cleared — the re-formed gang decodes
+        it from the prompt again.  Returns how many were requeued."""
+        with self._lock:
+            inflight = [r for r in self._slots if r is not None]
+            inflight.sort(key=lambda r: r.t_submit)
+            for req in reversed(inflight):
+                req.tokens = []
+                req.slot = None
+                self._queue.appendleft(req)
+            self._slots = [None] * self.max_batch
+            if inflight:
+                _tmx.set_gauge("hvd_serve_queue_depth", len(self._queue))
+                _tmx.set_gauge("hvd_serve_batch_occupancy", 0)
+        for _ in inflight:
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("replayed",))
+        return len(inflight)
+
+    def fail_all(self, reason: str) -> None:
+        """Unrecoverable serving failure: error out every queued and
+        active request so no handler thread blocks forever."""
+        with self._lock:
+            pending = [r for r in self._slots if r is not None]
+            pending.extend(self._queue)
+            self._queue.clear()
+            self._slots = [None] * self.max_batch
+        for req in pending:
+            req.error = reason
+            req.done.set()
+
+    # -- introspection ---------------------------------------------------
+
+    def active_count(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    def active_slots(self) -> Dict[int, Request]:
+        with self._lock:
+            return {i: r for i, r in enumerate(self._slots)
+                    if r is not None}
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or \
+                any(r is not None for r in self._slots)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "queued": len(self._queue),
+                "active": sum(1 for r in self._slots if r is not None),
+                "slots": self.max_batch,
+                "completed": self._completed,
+            }

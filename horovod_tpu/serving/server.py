@@ -1,0 +1,148 @@
+"""Rank-0 HTTP front door for the serving gang.
+
+Same ThreadingHTTPServer shape as the metrics debug server
+(telemetry/server.py) and the rendezvous server: HTTP/1.1 keep-alive,
+silent request logging, chaos-shed hook first.  ``POST /generate``
+blocks the handler thread until the scheduler completes (or fails) the
+request; ``GET /stats`` and ``GET /health`` answer immediately.
+
+Shedding is explicit and typed: the ``serve.admit`` chaos site or a
+full admission queue answers 503 (the client's signal to back off or
+go to another replica), a malformed body 400, and a request that
+outlives ``timeout_s`` 504 — the handler gives up, the request itself
+stays admitted (at-least-once, not exactly-once).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from horovod_tpu.common import fault_injection as _fi
+from horovod_tpu.serving.scheduler import QueueFull, Scheduler
+from horovod_tpu.telemetry import registry as _tmx
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    scheduler: Scheduler = None  # class attrs installed by FrontDoor
+    timeout_s: float = 120.0
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _chaos_unavailable(self) -> bool:
+        try:
+            _fi.fire("serve.admit", f"{self.command} {self.path}")
+        except _fi.InjectedFault:
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("shed",))
+            self._send(503, b"", "text/plain")
+            return True
+        return False
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj).encode("utf-8"),
+                   "application/json")
+
+    def do_GET(self):
+        if self._chaos_unavailable():
+            return
+        if self.path == "/health":
+            self._send(200, b"ok", "text/plain")
+            return
+        if self.path == "/stats":
+            self._send_json(200, self.scheduler.stats())
+            return
+        self._send(404, b"", "text/plain")
+
+    def do_POST(self):
+        if self._chaos_unavailable():
+            return
+        if self.path != "/generate":
+            self._send(404, b"", "text/plain")
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = [int(t) for t in body["prompt"]]
+            max_new = int(body.get("max_new_tokens", 16))
+        except (ValueError, KeyError, TypeError, json.JSONDecodeError):
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("error",))
+            self._send_json(400, {"error": "bad request body"})
+            return
+        try:
+            req = self.scheduler.submit(prompt, max_new)
+        except QueueFull as e:
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("shed",))
+            self._send_json(503, {"error": str(e)})
+            return
+        except ValueError as e:
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("error",))
+            self._send_json(400, {"error": str(e)})
+            return
+        if not req.done.wait(self.timeout_s):
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("error",))
+            self._send_json(504, {"error": "request timed out",
+                                  "id": req.id})
+            return
+        if req.error is not None:
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("error",))
+            self._send_json(500, {"error": req.error, "id": req.id})
+            return
+        import time
+
+        now = time.monotonic()
+        self._send_json(200, {
+            "id": req.id,
+            "tokens": req.tokens,
+            "attempts": req.attempts,
+            "ttft_ms": round((req.t_first_token - req.t_submit) * 1e3, 3)
+            if req.t_first_token else None,
+            "latency_ms": round((now - req.t_submit) * 1e3, 3),
+        })
+
+
+class FrontDoor:
+    """Threaded /generate endpoint on rank 0; ``start()`` returns the
+    bound port.  Survives gang re-forms — the scheduler (and the
+    handler threads parked on request Events) belong to the process,
+    not to an engine incarnation."""
+
+    def __init__(self, scheduler: Scheduler, *, host: str = "0.0.0.0",
+                 port: int = 0, timeout_s: float = 120.0):
+        handler = type("_BoundHandler", (_Handler,),
+                       {"scheduler": scheduler, "timeout_s": timeout_s})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-serve-http",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()

@@ -132,6 +132,21 @@ KNOWN_METRICS: Dict[str, dict] = {
     "hvd_straggler_events_total": _counter(
         "STRAGGLER records emitted (rank consistently last beyond "
         "HVD_STRAGGLER_WARN_MS).", ("rank",)),
+    # -- inference serving (serving/) --
+    "hvd_serve_requests_total": _counter(
+        "Serving requests by terminal status (ok / shed / error / "
+        "replayed — replayed counts re-admissions after a re-form, "
+        "the same request later lands in ok).", ("status",)),
+    "hvd_serve_queue_depth": _gauge(
+        "Requests waiting for a decode slot (rank 0)."),
+    "hvd_serve_batch_occupancy": _gauge(
+        "Decode slots currently serving a request (rank 0)."),
+    "hvd_serve_ttft_seconds": _hist(
+        "Time to first token: submit to first sampled token.",
+        *_SECONDS),
+    "hvd_serve_token_latency_seconds": _hist(
+        "Wall time of one gang decode step (prefills + batched step + "
+        "token-agreement allreduce).", *_SECONDS),
 }
 
 

@@ -102,6 +102,13 @@ METRICS_PORT = "HVD_METRICS_PORT"
 METRICS_FILE = "HVD_METRICS_FILE"
 METRICS_INTERVAL = "HVD_METRICS_INTERVAL"
 STRAGGLER_WARN_MS = "HVD_STRAGGLER_WARN_MS"
+# Inference serving (horovod_tpu.serving; docs/serving.md).  PORT is the
+# rank-0 HTTP front door (0 = ephemeral); MAX_BATCH is the number of
+# continuous-batching decode slots; MAX_QUEUE bounds the admission queue
+# (a full queue sheds with HTTP 503).
+SERVE_PORT = "HVD_SERVE_PORT"
+SERVE_MAX_BATCH = "HVD_SERVE_MAX_BATCH"
+SERVE_MAX_QUEUE = "HVD_SERVE_MAX_QUEUE"
 
 
 def get_bool(name: str, default: bool = False) -> bool:
@@ -161,6 +168,22 @@ def collective_timeout_s() -> float:
     """Per-collective deadline in seconds; 0 (default) = no deadline,
     the seed's block-forever behavior."""
     return max(0.0, get_float(COLLECTIVE_TIMEOUT, 0.0))
+
+
+def serve_port() -> int:
+    """Rank-0 serving front-door port; 0 (default) binds ephemeral."""
+    return max(0, get_int(SERVE_PORT, 0))
+
+
+def serve_max_batch() -> int:
+    """Continuous-batching decode slots; floor 1."""
+    return max(1, get_int(SERVE_MAX_BATCH, 8))
+
+
+def serve_max_queue() -> int:
+    """Admission queue bound (beyond it, /generate sheds with a 503);
+    floor 1."""
+    return max(1, get_int(SERVE_MAX_QUEUE, 64))
 
 
 def send_wait_cap_s() -> float:

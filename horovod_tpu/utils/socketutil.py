@@ -45,6 +45,11 @@ TAG_ABORT_REPORT = 6    # worker -> coordinator: local hop timeout
 TAG_PROBE = 7           # coordinator -> workers: are you wedged?
 TAG_PROBE_ACK = 8       # worker -> coordinator: busy flag + duration
 TAG_ABORT_VERDICT = 9   # coordinator -> workers: agreed wedged ranks
+# Serving admission broadcast (Python engine only, like the abort tags):
+# rank 0's continuous-batching scheduler pushes each decode step's batch
+# delta to every rank so the whole gang steps the same jit-ed decode
+# function.  Payload codec: common/wire.py; protocol: docs/serving.md.
+TAG_SERVE = 10          # coordinator -> workers: serve-step batch delta
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:

@@ -1,0 +1,136 @@
+"""Serving-gang scenario worker for tests/test_serving.py.
+
+Every rank of the gang runs this script: it builds the same tiny
+deterministic transformer (seed 0, float32 — so all ranks hold
+identical params with no broadcast) and enters ``ServingLoop.run()``.
+Rank 0 writes its front-door port to ``SERVE_PORT_FILE`` so the driving
+test can POST ``/generate`` from outside, and stops the loop once
+``SERVE_EXPECT`` requests have completed.
+
+Scenario knobs (env):
+
+* ``SERVE_VICTIM=1`` + ``SERVE_STALL_SEQ=<k>`` — this rank arms a
+  data-plane stall (``SERVE_SITE``: ``sock.stall`` or ``shm.stall``)
+  right before applying serve frame ``k``, wedging itself inside that
+  step's token-agreement allreduce.  The survivors' collective deadline
+  must evict it (PR-6 abort agreement) and the re-formed gang must
+  finish every admitted request — this worker never exits on its own.
+* A straggler is injected from the *outside* via ``HOROVOD_FAULT_PLAN``
+  (``serve.step``/``delay`` fires only inside serving steps, so arming
+  it at launch is safe).
+
+Markers (flush=True): ``PORT <p>``, ``GEN <n>`` (serve generation on
+each incarnation), ``DONE``; leak assertions run after shutdown (no
+``hvd-send-*`` threads, no ``/dev/shm/hvd-shm-*`` segments — the same
+hygiene contract as tests/timeout_worker.py / tests/shm_worker.py).
+"""
+
+import glob
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+    from horovod_tpu.common import fault_injection as fi
+    from horovod_tpu.common import wire
+    from horovod_tpu.models import transformer as tfm
+    from horovod_tpu.serving import ServingLoop
+
+    cache_len = int(os.environ.get("SERVE_CACHE_LEN", "64"))
+    cfg = tfm.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=cache_len, compute_dtype=jax.numpy.float32,
+        remat=False)
+
+    hvd.init()
+    assert type(basics._runtime).__name__ == "PyEngine"
+    params = tfm.init(jax.random.PRNGKey(0), cfg)
+
+    port_file = os.environ.get("SERVE_PORT_FILE", "")
+    expect = int(os.environ.get("SERVE_EXPECT", "0"))
+
+    def on_ready(port):
+        print(f"PORT {port}", flush=True)
+        if port_file:
+            with open(port_file + ".tmp", "w") as f:
+                f.write(str(port))
+            os.replace(port_file + ".tmp", port_file)
+
+    loop_cls = ServingLoop
+    if os.environ.get("SERVE_VICTIM") == "1":
+        stall_seq = int(os.environ.get("SERVE_STALL_SEQ", "3"))
+        site = os.environ.get("SERVE_SITE", "sock.stall")
+
+        class VictimLoop(ServingLoop):
+            """Arms the transport stall right before applying one serve
+            frame, so this rank wedges inside that step's allreduce —
+            the in-process analogue of timeout_worker's mid-step GC
+            pause."""
+
+            _armed = False
+
+            def _apply_frame(self, frame, eng, engine, *, rank0):
+                seq, stopping, _, _ = wire.decode_serve_delta(frame)
+                if not stopping and seq >= stall_seq and \
+                        not VictimLoop._armed:
+                    VictimLoop._armed = True
+                    fi.configure({"faults": [
+                        {"site": site, "kind": "stall",
+                         "stall_s": 600}]})
+                return super()._apply_frame(frame, eng, engine,
+                                            rank0=rank0)
+
+        loop_cls = VictimLoop
+
+    loop = loop_cls(
+        params, cfg,
+        max_batch=int(os.environ.get("SERVE_MAX_BATCH", "2")),
+        max_queue=int(os.environ.get("SERVE_MAX_QUEUE", "16")),
+        port=0, host="127.0.0.1", cache_len=cache_len, eos_id=None,
+        request_timeout_s=90.0, on_ready=on_ready)
+
+    if expect and hvd.rank() == 0:
+        def stopper():
+            while True:
+                sch = loop.scheduler
+                if sch is not None and \
+                        sch.stats()["completed"] >= expect:
+                    loop.stop()
+                    return
+                time.sleep(0.05)
+
+        threading.Thread(target=stopper, daemon=True).start()
+
+    # Each incarnation logs its generation via the engine epoch so the
+    # driver can assert a re-form actually happened.
+    epoch0 = os.environ.get("HVD_ELASTIC_EPOCH", "0")
+    print(f"GEN {epoch0}", flush=True)
+    loop.run()
+    print(f"GEN_FINAL {os.environ.get('HVD_ELASTIC_EPOCH', epoch0)}",
+          flush=True)
+
+    def senders():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("hvd-send-")]
+
+    print("DONE", flush=True)
+    hvd.shutdown()
+    deadline = time.monotonic() + 10.0
+    while senders() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not senders(), \
+        f"sender threads leaked past shutdown: " \
+        f"{[t.name for t in senders()]}"
+    assert not glob.glob("/dev/shm/hvd-shm-*"), \
+        f"shm segments leaked: {glob.glob('/dev/shm/hvd-shm-*')}"
+
+
+if __name__ == "__main__":
+    main()
