@@ -8,10 +8,13 @@
 
 #if (defined(__x86_64__) || defined(_M_X64)) && \
     (defined(__GNUC__) || defined(__clang__))
-// GCC/Clang only: the fast paths use __builtin_cpu_supports and
-// __attribute__((target)) — other compilers take the scalar loops.
+// GCC/Clang only: the fast paths use __attribute__((target)) with a
+// raw-CPUID feature probe — other compilers take the scalar loops.
+// (__builtin_cpu_supports("f16c") is not accepted before gcc 11, so
+// the probe reads CPUID/XCR0 directly instead.)
 #define HVD_X86 1
 #include <immintrin.h>
+#include <cpuid.h>
 #endif
 
 namespace hvd {
@@ -244,9 +247,21 @@ namespace {
 bool SimdAvailable() {
 #ifdef HVD_X86
   static const bool ok = [] {
-    __builtin_cpu_init();
-    return __builtin_cpu_supports("avx2") &&
-           __builtin_cpu_supports("f16c");
+    // CPUID leaf 1 ECX: bit 27 OSXSAVE, bit 28 AVX, bit 29 F16C.
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    const bool osxsave = (ecx >> 27) & 1u;
+    const bool avx = (ecx >> 28) & 1u;
+    const bool f16c = (ecx >> 29) & 1u;
+    if (!(osxsave && avx && f16c)) return false;
+    // CPUID leaf 7 subleaf 0 EBX bit 5: AVX2.
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    if (!((ebx >> 5) & 1u)) return false;
+    // XCR0 must show the OS saves XMM (bit 1) and YMM (bit 2) state,
+    // else executing VEX-256 ops faults even though the CPU has them.
+    uint32_t xcr0_lo, xcr0_hi;
+    __asm__ volatile("xgetbv" : "=a"(xcr0_lo), "=d"(xcr0_hi) : "c"(0));
+    return (xcr0_lo & 0x6u) == 0x6u;
   }();
   return ok;
 #else

@@ -75,6 +75,7 @@ KNOWN_SITES = {
     "kv.get": "rendezvous KV client get",
     "kv.delete": "rendezvous KV client delete",
     "kv.server.request": "rendezvous server request handling",
+    "kv.mirror": "rendezvous primary->standby write-through mirroring",
     "metrics.server.request": "metrics debug-server request handling",
     "bootstrap.start": "worker bootstrap entry",
     "bootstrap.accept": "mesh listener accept loop",

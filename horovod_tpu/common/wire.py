@@ -397,10 +397,13 @@ def decode_abort_verdict(data: bytes) -> Tuple[str, List[int], int]:
 
 
 def encode_serve_delta(seq: int, stop: bool, admissions,
-                       epoch: int = 0) -> bytes:
+                       epoch: int = 0, leader_addr: str = "") -> bytes:
     """Coordinator -> workers: step ``seq``'s batch delta.
     ``admissions``: iterable of (slot, request_id, max_new_tokens,
-    prompt_tokens) with ``prompt_tokens`` an iterable of ints."""
+    prompt_tokens) with ``prompt_tokens`` an iterable of ints.
+    ``leader_addr`` (``host:port`` of the leader's front door, "" =
+    unknown) rides as a trailer AFTER the epoch so pre-trailer decoders
+    — which stop reading at the epoch — still parse the frame."""
     buf = bytearray()
     buf += struct.pack("<QBI", seq, 1 if stop else 0, len(admissions))
     for slot, req_id, max_new, prompt in admissions:
@@ -409,6 +412,7 @@ def encode_serve_delta(seq: int, stop: bool, admissions,
         prompt = [int(t) for t in prompt]
         buf += struct.pack(f"<I{len(prompt)}I", len(prompt), *prompt)
     buf += struct.pack("<I", epoch)
+    _pack_str(buf, leader_addr)
     return bytes(buf)
 
 
@@ -416,6 +420,12 @@ def decode_serve_delta(data: bytes):
     """Returns (seq, stop, admissions, epoch) — the encode_serve_delta
     arguments, with each admission as (slot, request_id, max_new_tokens,
     prompt_tokens list)."""
+    return decode_serve_delta_ex(data)[:4]
+
+
+def decode_serve_delta_ex(data: bytes):
+    """Returns (seq, stop, admissions, epoch, leader_addr); a frame
+    from an encoder without the leader trailer yields ``""``."""
     seq, stop, n = struct.unpack_from("<QBI", data, 0)
     off = struct.calcsize("<QBI")
     admissions = []
@@ -429,4 +439,8 @@ def decode_serve_delta(data: bytes):
         off += 4 * plen
         admissions.append((slot, req_id, max_new, prompt))
     (epoch,) = struct.unpack_from("<I", data, off)
-    return seq, bool(stop), admissions, epoch
+    off += 4
+    leader_addr = ""
+    if off < len(data):
+        leader_addr, off = _unpack_str(data, off)
+    return seq, bool(stop), admissions, epoch, leader_addr

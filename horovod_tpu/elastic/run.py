@@ -203,6 +203,8 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     """Tear down, compute the new world, and re-init under a new epoch."""
     from horovod_tpu import basics, process_sets
 
+    if 0 in failed:
+        _tmx.inc_counter("hvd_leader_failovers_total")
     _timeline_event("ELASTIC_RESET", failed=sorted(failed))
     ctx.stop_driver()
     basics.shutdown()
@@ -257,6 +259,14 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     ctx.consume_updates()
     ctx.maybe_start_driver()
     _tmx.inc_counter("hvd_elastic_reforms_total")
+    if 0 in failed:
+        # The gang's hub died and the lowest surviving old rank was
+        # elected leader by the world protocol above.  Recorded after
+        # re-init: before the rank renumbering the promoted process had
+        # no timeline (only rank 0 writes one), so an earlier emit
+        # would land nowhere — the dead hub can't record its own death.
+        _timeline_event("LEADER_FAILOVER", failed=sorted(failed),
+                        epoch=ctx.epoch - 1, new_leader=new_rank == 0)
     _timeline_event("ELASTIC_REFORM", epoch=new_epoch, size=len(world))
     ctx.log.info("gang re-formed: epoch %d, rank %d/%d",
                  new_epoch, new_rank, len(world))
@@ -405,7 +415,20 @@ def run(func):
                 except HostsUpdatedInterrupt:
                     failed = set()
                 except RuntimeError:
+                    # A dead hub surfaces twice on a worker: the training
+                    # /serving thread's collective fails with a raw
+                    # socket error FIRST, and the engine's own
+                    # lost-coordinator abort (recv-loop EOF -> worker
+                    # cycle) lands a beat later.  Poll briefly for the
+                    # abort verdict before concluding this RuntimeError
+                    # is not a hub failure.
                     reason = _engine_abort_reason()
+                    if reason is None:
+                        deadline = time.monotonic() + 2.0
+                        while reason is None and \
+                                time.monotonic() < deadline:
+                            time.sleep(0.05)
+                            reason = _engine_abort_reason()
                     if reason is None or "coordinator" not in reason:
                         raise
                     # The star's hub died: that is a failure of rank 0.

@@ -218,9 +218,11 @@ def _attention(x, lp, cfg: TransformerConfig, mesh=None):
             # a manual-dp shard_map (tp/sp are 1 here by the guard).
             from jax.sharding import PartitionSpec as _P
 
-            ctx = jax.shard_map(
+            from horovod_tpu.parallel.shard import shard_map as _shmap
+
+            ctx = _shmap(
                 lambda a, b, c: flash_attention(a, b, c, causal=True),
-                mesh=mesh, axis_names=frozenset({"dp"}),
+                mesh, axis_names=frozenset({"dp"}),
                 in_specs=(_P("dp"), _P("dp"), _P("dp")),
                 out_specs=_P("dp"), check_vma=False)(q, kk, v)
         else:

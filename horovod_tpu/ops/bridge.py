@@ -203,6 +203,17 @@ _FFI_DTYPES = ("float32", "float64", "float16", "bfloat16",
                "uint16", "int32", "int64", "bool")
 
 
+def _ffi_api():
+    # jax < 0.4.38 ships the same surface (register_ffi_target,
+    # pycapsule, ffi_call) under jax.extend.ffi instead of jax.ffi.
+    import jax
+
+    mod = getattr(jax, "ffi", None)
+    if mod is None:
+        from jax.extend import ffi as mod
+    return mod
+
+
 def _native_ffi_ready() -> bool:
     import os
 
@@ -227,9 +238,10 @@ def _native_ffi_ready() -> bool:
             lib = native.load()
             handler = getattr(lib, "HvdGroupedAllreduce", None)
             if handler is not None:
-                jax.ffi.register_ffi_target(
+                ffi = _ffi_api()
+                ffi.register_ffi_target(
                     "hvd_grouped_allreduce",
-                    jax.ffi.pycapsule(handler), platform="cpu")
+                    ffi.pycapsule(handler), platform="cpu")
                 _ffi_state["registered"] = True
         except Exception:
             _ffi_state["registered"] = False
@@ -248,12 +260,10 @@ def _ffi_eligible(leaves, compression) -> bool:
 
 
 def _ffi_grouped_call(leaves, base, op, prescale, postscale, process_set):
-    import jax
-
     ps_id, ps_size = 0, 0
     if process_set is not None:
         ps_id, ps_size = process_set.validate(basics.rank(), basics.size())
-    call = jax.ffi.ffi_call(
+    call = _ffi_api().ffi_call(
         "hvd_grouped_allreduce",
         tuple(_spec_like(l) for l in leaves),
         has_side_effect=True)

@@ -49,6 +49,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.parallel.mesh import filter_spec
+from horovod_tpu.parallel.shard import shard_map
 from horovod_tpu.parallel.train import _step0
 
 
@@ -98,8 +99,11 @@ def gpipe(stage_fn, x_mb, *, axis: str = "pp"):
 
     # The carry becomes pp-varying after one tick (each stage holds its
     # own activations), so it must *start* varying for scan's type check.
+    # jax < 0.5 has no varying-manual-axes typing (no lax.pcast) and no
+    # such check: the seed is used as-is there.
+    _pcast = getattr(lax, "pcast", lambda a, _axis, to: a)
     carry0 = jax.tree.map(
-        lambda a: lax.pcast(a, axis, to="varying"),
+        lambda a: _pcast(a, axis, to="varying"),
         (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb),
          jnp.zeros((), jnp.float32)))
     (_, out, aux_sum), _ = lax.scan(tick, carry0, jnp.arange(ticks))
@@ -177,9 +181,9 @@ def pipeline_apply(params, tokens, cfg: tfm.TransformerConfig, mesh,
     # partial-manual shard_map only admits unmentioned-axis out_specs when
     # replication over pp is provable, which the masked-psum broadcast at
     # the end of gpipe() establishes.
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body, mesh=mesh, axis_names=frozenset({"pp"}),
-        in_specs=(pp_only, P()), out_specs=(P(), P()))
+        in_specs=(pp_only, P()), out_specs=(P(), P()), check_vma=True)
     return sharded(params, tokens)
 
 

@@ -28,10 +28,28 @@ _PARAMS = set(inspect.signature(_SHARD_MAP).parameters)
 
 def shard_map(f, mesh, in_specs, out_specs, **kwargs: Any):
     """``shard_map(f, mesh, in_specs, out_specs)`` with VMA checking off
-    unless explicitly requested."""
+    unless explicitly requested.  Accepts the current keyword surface on
+    every supported jax: ``check_vma`` maps to the older ``check_rep``,
+    and partial-manual ``axis_names`` maps to the pre-0.5 ``auto``
+    complement (the axes left automatic)."""
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", False))
     if "check_vma" in _PARAMS:
-        kwargs.setdefault("check_vma", False)
+        kwargs["check_vma"] = check
     elif "check_rep" in _PARAMS:
-        kwargs.setdefault("check_rep", False)
-    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, **kwargs)
+        kwargs["check_rep"] = check
+    manual = kwargs.pop("axis_names", None)
+    jit_wrap = False
+    if manual is not None:
+        if "axis_names" in _PARAMS:
+            kwargs["axis_names"] = frozenset(manual)
+        elif "auto" in _PARAMS:
+            kwargs["auto"] = \
+                frozenset(mesh.axis_names) - frozenset(manual)
+            # pre-0.5 partial-auto only exists on the jit lowering path
+            # (the eager impl and the replication checker both raise
+            # NotImplementedError for it)
+            kwargs["check_rep"] = False
+            jit_wrap = bool(kwargs["auto"])
+    mapped = _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, **kwargs)
+    return jax.jit(mapped) if jit_wrap else mapped

@@ -41,6 +41,7 @@ _ARG_ENV = {
     "serve_port": E.SERVE_PORT,
     "serve_max_batch": E.SERVE_MAX_BATCH,
     "serve_max_queue": E.SERVE_MAX_QUEUE,
+    "kv_addrs": E.KV_ADDRS,
 }
 
 _MB = {"fusion_threshold_mb"}

@@ -13,6 +13,13 @@ a rendezvous server that is still binding, restarting, or sheds a
 request under load (5xx) costs a delay, not the job.  Client errors
 (4xx) are never retried — a 404 is a legitimate "key not there yet"
 answer the callers poll on.
+
+When ``HVD_KV_ADDRS`` holds a comma-separated ``host:port`` list the
+client treats it as an ordered endpoint set (primary first, warm
+standbys after) and rotates to the next endpoint on every retryable
+failure, inside the same retry budget.  The HMAC signature covers
+method+path+body but never the host, so a failover needs no re-signing.
+Unset, behavior is byte-identical to the single-address client.
 """
 
 from __future__ import annotations
@@ -23,7 +30,7 @@ import time
 import urllib.error
 import urllib.request
 import zlib
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.retry import retry_call
@@ -45,17 +52,68 @@ def _retryable(e: BaseException) -> bool:
                           socket.timeout, TimeoutError, OSError))
 
 
+def parse_kv_addrs(spec: str) -> List[Tuple[str, int]]:
+    """Parse a comma-separated ``host:port`` endpoint list (the
+    ``HVD_KV_ADDRS`` format).  Raises ``ValueError`` with an
+    actionable message on any malformed entry — the launcher turns
+    that into an exit-2 usage error before a single worker starts."""
+    endpoints: List[Tuple[str, int]] = []
+    for raw in spec.split(","):
+        entry = raw.strip()
+        if not entry:
+            raise ValueError(
+                f"HVD_KV_ADDRS has an empty entry in {spec!r}; expected "
+                f"a comma-separated host:port list")
+        host, sep, port_s = entry.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"HVD_KV_ADDRS entry {entry!r} is not host:port")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"HVD_KV_ADDRS entry {entry!r} has a non-numeric "
+                f"port {port_s!r}") from None
+        if not 1 <= port <= 65535:
+            raise ValueError(
+                f"HVD_KV_ADDRS entry {entry!r} has port {port} outside "
+                f"1..65535")
+        endpoints.append((host, port))
+    if not endpoints:
+        raise ValueError("HVD_KV_ADDRS is empty")
+    return endpoints
+
+
 class KVClient:
     def __init__(self, host: str, port: int,
                  secret: Optional[str] = None):
-        self.host = host
-        self.port = port
+        addrs = os.environ.get(env_util.KV_ADDRS, "").strip()
+        if addrs:
+            self.endpoints = parse_kv_addrs(addrs)
+        else:
+            self.endpoints = [(host, int(port))]
+        self._active = 0
         self.secret = (secret if secret is not None
                        else os.environ.get(secret_mod.ENV_VAR) or None)
         self.attempts = max(1, env_util.get_int("HVD_KV_RETRIES", 4))
         self.timeout = env_util.get_float("HVD_KV_TIMEOUT", 10.0)
         self.retry_base = env_util.get_float("HVD_KV_RETRY_BASE_S", 0.05)
         self.retry_max = env_util.get_float("HVD_KV_RETRY_MAX_S", 2.0)
+
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._active][0]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._active][1]
+
+    def _rotate_endpoint(self) -> None:
+        # Deterministic failover order: primary, standby 1, standby 2,
+        # wrap.  Sticky across calls — once a standby answers, stay on
+        # it rather than re-probing the dead primary every request.
+        if len(self.endpoints) > 1:
+            self._active = (self._active + 1) % len(self.endpoints)
 
     def _url(self, path: str) -> str:
         return f"http://{self.host}:{self.port}{path}"
@@ -75,10 +133,17 @@ class KVClient:
             _fi.fire(site, key)
             return fn()
 
+        def on_retry(attempt_index, exc):
+            _count_retry(attempt_index, exc)
+            # A retryable failure on a multi-endpoint client means this
+            # endpoint may be dead — the next attempt goes to the next
+            # address in the list (no-op for single-address clients).
+            self._rotate_endpoint()
+
         return retry_call(
             attempt, attempts=self.attempts,
             base_delay=self.retry_base, max_delay=self.retry_max,
-            is_retryable=_retryable, on_retry=_count_retry,
+            is_retryable=_retryable, on_retry=on_retry,
             seed=zlib.crc32(key.encode("utf-8")))
 
     def put(self, key: str, value) -> None:

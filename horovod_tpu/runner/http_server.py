@@ -7,20 +7,32 @@ exchange addresses at startup and to return run-function results).
 Protocol: ``PUT /kv/<key>`` stores the body; ``GET /kv/<key>`` returns it or
 404; ``DELETE /kv/<key>`` removes it; ``GET /kvlist/<prefix>`` returns the
 matching keys, newline-separated (the elastic driver enumerates pending
-joiners this way); ``GET /health`` returns ``ok``.
+joiners this way); ``GET /health`` returns ``ok``; ``GET /kvsync`` returns
+the full store as JSON (base64 values) so a warm standby can catch up.
 
 When the server holds a job secret (parity: ``run/common/util/secret.py``
 HMAC framing), every ``/kv/`` request must carry a valid
 ``X-HVD-Auth: HMAC-SHA256(method, path, body)`` header or it is rejected
 with 403 — an unauthenticated client on the network can neither read nor
 poison rendezvous state.
+
+Replication: a server constructed with ``mirrors=[(host, port), ...]``
+write-through-forwards every accepted ``PUT``/``DELETE`` to each mirror
+over the same HMAC'd protocol (chaos site ``kv.mirror``; a failed mirror
+write is logged and dropped — the standby's ``/kvsync`` catch-up on
+restart is the repair path).  Clients fail over between primary and
+standbys via ``HVD_KV_ADDRS`` (see runner/http_client.py).
 """
 
 from __future__ import annotations
 
+import base64
+import json
+import sys
 import threading
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.runner import secret as secret_mod
@@ -74,6 +86,17 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             self._reject()
             return
+        if self.path == "/kvsync":
+            # Full-state dump for standby catch-up: {key: b64(value)}.
+            with self.server.kv_lock:  # type: ignore[attr-defined]
+                snap = {k: base64.b64encode(v).decode("ascii")
+                        for k, v in self._store().items()}
+            body = json.dumps(snap).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path.startswith("/kvlist/"):
             prefix = self.path[len("/kvlist/"):]
             with self.server.kv_lock:  # type: ignore[attr-defined]
@@ -112,6 +135,8 @@ class _Handler(BaseHTTPRequestHandler):
         if key:
             with self.server.kv_lock:  # type: ignore[attr-defined]
                 self._store()[key] = body
+            self.server.mirror_write(  # type: ignore[attr-defined]
+                "PUT", key, body)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -125,9 +150,49 @@ class _Handler(BaseHTTPRequestHandler):
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self._store().pop(key, None)
+        if key:
+            self.server.mirror_write(  # type: ignore[attr-defined]
+                "DELETE", key, None)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+
+class _KVServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer + write-through mirroring to warm standbys."""
+
+    daemon_threads = True
+
+    kv_store: Dict[str, bytes]
+    kv_lock: threading.Lock
+    kv_secret: Optional[str]
+    kv_mirrors: List[Tuple[str, int]]
+    kv_mirror_timeout: float
+
+    def mirror_write(self, method: str, key: str,
+                     body: Optional[bytes]) -> None:
+        """Forward an accepted mutation to every standby.  Best-effort:
+        a dead/slow mirror costs one short timeout, never the request —
+        the standby repairs itself on restart via ``/kvsync``.  The
+        ``kv.mirror`` chaos site drops individual forwards so tests can
+        prove a torn mirror stream is absorbed."""
+        for host, port in self.kv_mirrors:
+            try:
+                _fi.fire("kv.mirror", f"{method} {key} -> {host}:{port}")
+                path = f"/kv/{key}"
+                req = urllib.request.Request(
+                    f"http://{host}:{port}{path}", data=body,
+                    method=method)
+                if self.kv_secret is not None:
+                    req.add_header(secret_mod.HEADER, secret_mod.sign(
+                        self.kv_secret, method, path, body or b""))
+                with urllib.request.urlopen(
+                        req, timeout=self.kv_mirror_timeout):
+                    pass
+            except Exception:
+                # Mirror unreachable / chaos-dropped: the write is
+                # already durable on this server; skip the standby.
+                pass
 
 
 class RendezvousServer:
@@ -135,23 +200,35 @@ class RendezvousServer:
 
     ``secret``: when given, requests must be HMAC-signed (see module
     docstring); ``None`` (default) keeps the open behavior for loopback
-    test fixtures."""
+    test fixtures.  ``mirrors``: optional ``(host, port)`` standbys that
+    receive a write-through copy of every PUT/DELETE."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 secret: Optional[str] = None):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.kv_store = {}  # type: ignore[attr-defined]
-        self._httpd.kv_lock = threading.Lock()  # type: ignore[attr-defined]
-        self._httpd.kv_secret = secret  # type: ignore[attr-defined]
+                 secret: Optional[str] = None,
+                 mirrors: Optional[Sequence[Tuple[str, int]]] = None,
+                 mirror_timeout: float = 2.0):
+        self._httpd = _KVServer((host, port), _Handler)
+        self._httpd.kv_store = {}
+        self._httpd.kv_lock = threading.Lock()
+        self._httpd.kv_secret = secret
+        self._httpd.kv_mirrors = [(h, int(p)) for h, p in (mirrors or [])]
+        self._httpd.kv_mirror_timeout = mirror_timeout
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
 
-    def start(self) -> int:
+    @property
+    def mirrors(self) -> List[Tuple[str, int]]:
+        return list(self._httpd.kv_mirrors)
+
+    def set_mirrors(self, mirrors: Sequence[Tuple[str, int]]) -> None:
+        self._httpd.kv_mirrors = [(h, int(p)) for h, p in mirrors]
+
+    def start(self, name: str = "hvd-rendezvous") -> int:
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="hvd-rendezvous",
+            target=self._httpd.serve_forever, name=name,
             daemon=True)
         self._thread.start()
         return self.port
@@ -166,3 +243,79 @@ class RendezvousServer:
     def get(self, key: str) -> Optional[bytes]:
         with self._httpd.kv_lock:  # type: ignore[attr-defined]
             return self._httpd.kv_store.get(key)  # type: ignore
+
+    def sync_from(self, host: str, port: int,
+                  timeout: float = 5.0) -> bool:
+        """Standby catch-up: replace this store with the source server's
+        ``/kvsync`` snapshot.  Returns False (leaving the store alone)
+        when the source is unreachable — a standby that starts before
+        its primary simply begins empty and fills via mirroring."""
+        path = "/kvsync"
+        req = urllib.request.Request(f"http://{host}:{port}{path}",
+                                     method="GET")
+        secret = self._httpd.kv_secret
+        if secret is not None:
+            req.add_header(secret_mod.HEADER, secret_mod.sign(
+                secret, "GET", path, b""))
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                snap = json.loads(r.read().decode("utf-8"))
+        except Exception:
+            return False
+        store = {k: base64.b64decode(v) for k, v in snap.items()}
+        with self._httpd.kv_lock:
+            self._httpd.kv_store.clear()
+            self._httpd.kv_store.update(store)
+        return True
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """Standalone KV server process (``python -m
+    horovod_tpu.runner.http_server``) — lets tests and operators run the
+    primary and its standbys as separate killable processes.  The secret
+    comes from ``HVD_SECRET_KEY`` when set."""
+    import argparse
+    import os
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="horovod_tpu.runner.http_server",
+        description="Standalone rendezvous KV server (primary or standby).")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--port-file", default="",
+                        help="write the bound port here (atomic rename)")
+    parser.add_argument("--mirror", action="append", default=[],
+                        metavar="HOST:PORT",
+                        help="standby to write-through mirror to "
+                             "(repeatable)")
+    parser.add_argument("--sync-from", default="", metavar="HOST:PORT",
+                        help="catch up from this server's /kvsync at start")
+    args = parser.parse_args(argv)
+
+    from horovod_tpu.runner.http_client import parse_kv_addrs
+
+    mirrors = [parse_kv_addrs(m)[0] for m in args.mirror]
+    secret = os.environ.get(secret_mod.ENV_VAR) or None
+    server = RendezvousServer(host=args.host, port=args.port,
+                              secret=secret, mirrors=mirrors)
+    if args.sync_from:
+        src = parse_kv_addrs(args.sync_from)[0]
+        server.sync_from(src[0], src[1])
+    port = server.start(name="hvd-kv-main")
+    if args.port_file:
+        with open(args.port_file + ".tmp", "w") as f:
+            f.write(str(port))
+        os.replace(args.port_file + ".tmp", args.port_file)
+    print(f"KV {args.host}:{port}", flush=True)
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    signal.signal(signal.SIGINT, lambda *_: done.set())
+    done.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -283,6 +283,24 @@ def make_parser() -> argparse.ArgumentParser:
                     help="admission queue bound; beyond it the front "
                          "door sheds with HTTP 503")
 
+    kv = p.add_argument_group(
+        "rendezvous availability",
+        "surviving the KV store's death (docs/fault_tolerance.md "
+        "\"surviving rank 0\"): standbys receive a write-through mirror "
+        "of every PUT/DELETE and clients fail over down the endpoint "
+        "list inside their normal retry budget.")
+    kv.add_argument("--kv-standbys", type=int, dest="kv_standbys",
+                    help="start N warm standby KV servers (0..2) next "
+                         "to the primary; workers get the full endpoint "
+                         "list via HVD_KV_ADDRS and fail over if the "
+                         "primary dies")
+    kv.add_argument("--kv-addrs", dest="kv_addrs",
+                    help="comma-separated host:port list of externally "
+                         "managed rendezvous KV endpoints (primary "
+                         "first); exported to workers as HVD_KV_ADDRS "
+                         "verbatim (mutually exclusive with "
+                         "--kv-standbys)")
+
     p.add_argument("--log-level", dest="log_level",
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -348,6 +366,23 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         if val is not None and val < 1:
             print(f"{_prog_name()}: {flag} must be >= 1 (got {val})",
                   file=sys.stderr)
+            return 2
+    if args.kv_standbys is not None and not (0 <= args.kv_standbys <= 2):
+        print(f"{_prog_name()}: --kv-standbys must be in 0..2 "
+              f"(got {args.kv_standbys})", file=sys.stderr)
+        return 2
+    if args.kv_addrs is not None:
+        if args.kv_standbys:
+            print(f"{_prog_name()}: --kv-addrs and --kv-standbys are "
+                  "mutually exclusive (either the launcher runs the "
+                  "standbys or you point at external ones)",
+                  file=sys.stderr)
+            return 2
+        from horovod_tpu.runner.http_client import parse_kv_addrs
+        try:
+            parse_kv_addrs(args.kv_addrs)
+        except ValueError as e:
+            print(f"{_prog_name()}: --kv-addrs: {e}", file=sys.stderr)
             return 2
     for flag, val in (("--ring-segment-bytes", args.ring_segment_bytes),
                       ("--sock-buf-bytes", args.sock_buf_bytes),
@@ -487,6 +522,21 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         except Exception as e:  # discovery must never kill the launch
             print(f"{_prog_name()}: NIC ring probe failed ({e}); "
                   "falling back to the default route", file=sys.stderr)
+    standbys = []
+    if args.kv_standbys:
+        # Warm standbys next to the primary: each syncs nothing (the
+        # store is empty at launch) and receives a write-through copy
+        # of every mutation; workers learn the whole endpoint list.
+        for i in range(args.kv_standbys):
+            sb = RendezvousServer(host=nic_addr or "0.0.0.0",
+                                  secret=job_secret)
+            sb.start(name=f"hvd-kv-standby-{i}")
+            standbys.append(sb)
+        server.set_mirrors([(addr, sb.port) for sb in standbys])
+        env_extra["HVD_KV_ADDRS"] = ",".join(
+            [f"{addr}:{port}"] + [f"{addr}:{sb.port}" for sb in standbys])
+    elif args.kv_addrs is not None:
+        env_extra["HVD_KV_ADDRS"] = args.kv_addrs
     output = None
     if args.output_filename:
         output = open(args.output_filename, "w")
@@ -642,6 +692,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         if output is not None:
             output.close()
         server.stop()
+        for sb in standbys:
+            sb.stop()
 
 
 def _is_local(hostname: str) -> bool:

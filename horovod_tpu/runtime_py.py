@@ -452,6 +452,7 @@ class PyEngine(_EngineBase):
         self._evicted_ranks: set = set()      # dead ranks, every rank
         self._ranks_failed: List[int] = []    # raises on next enqueue
         self._conn_lost: set = set()          # recv threads -> coord cycle
+        self._ctrl_conn_lost = False          # worker: coordinator EOF
         self._last_seen: Dict[int, float] = {}
         self._last_send = time.monotonic()
 
@@ -625,7 +626,21 @@ class PyEngine(_EngineBase):
                         self._serve_inbox.append(payload)
                         self._serve_cv.notify_all()
         except (ConnectionError, OSError):
-            pass
+            # Coordinator EOF/reset.  During a negotiated shutdown (or
+            # after our own close) this is expected teardown noise;
+            # otherwise it is the fastest dead-hub signal the worker
+            # has — the next worker cycle drains any already-received
+            # shutdown frame and only then declares the hub lost.
+            if not (self._shutdown_flag.is_set()
+                    or self._shutdown_requested.is_set()
+                    or self._closed):
+                self._ctrl_conn_lost = True
+                # Wake a serving loop parked in serve_recv: the abort
+                # it needs fires from the next worker cycle, but the
+                # cycle only runs every cycle_time — notify so nothing
+                # sleeps a full timeout on a dead hub.
+                with self._serve_cv:
+                    self._serve_cv.notify_all()
 
     # -- serving admission broadcast (docs/serving.md) -------------------
 
@@ -1084,7 +1099,19 @@ class PyEngine(_EngineBase):
             if shutdown:
                 self._shutdown_flag.set()
                 return False
-        if send_failed:  # no shutdown in flight: genuine lost peer
+        if send_failed or self._ctrl_conn_lost:
+            # A send failure or a recv-thread EOF both mean the hub is
+            # unreachable — but a shutdown ResponseList may have landed
+            # in the inbox between the drain above and now.  Drain once
+            # more so clean teardown never masquerades as a dead hub.
+            with self._response_lock:
+                late = self._response_inbox
+                self._response_inbox = []
+            for payload in late:
+                decoded = wire.decode_response_list(payload)
+                if decoded[1] and decoded[5] == self.epoch:  # shutdown
+                    self._shutdown_flag.set()
+                    return False
             self._abort("lost connection to coordinator")
             return False
         return True

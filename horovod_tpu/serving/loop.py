@@ -96,10 +96,18 @@ class ServingLoop:
         self.scheduler: Optional[Scheduler] = None
         self._door: Optional[FrontDoor] = None
         self._stop = threading.Event()
-        # slot -> {"id": request id, "remaining": tokens still owed}.
-        # Rebuilt from scratch each epoch; every rank derives the same
-        # dict from the same frame stream.
+        # slot -> {"id", "prompt", "max_new", "remaining"}.  Every rank
+        # derives the same dict from the same frame stream — this IS the
+        # follower's shadow of the leader's in-flight table: a rank
+        # promoted to 0 by a re-form seeds its fresh scheduler from it
+        # (prompt + max_new are all a replay needs; greedy decode
+        # rebuilds the token tail bit-identically).
         self._slots: Dict[int, Dict] = {}
+        # Leader front-door address ("host:port") as last seen in a
+        # serve-delta frame; authoritative copy lives under the
+        # elastic-scoped KV key serve/leader.
+        self._known_leader: Optional[str] = None
+        self._elastic_ctx = None
         self.log = get_logger(0)
 
     # -- lifecycle -------------------------------------------------------
@@ -147,39 +155,114 @@ class ServingLoop:
             raise RuntimeError(
                 "serving requires the Python engine (HVD_TPU_CORE=py)")
         self.log = get_logger(basics.rank())
+        self._elastic_ctx = getattr(state, "_elastic_ctx", None)
         engine = DecodeEngine(self.params, self.cfg,
                               max_batch=self.max_batch,
                               cache_len=self.cache_len, mesh=self.mesh)
+        # The previous incarnation's in-flight table survives the reset
+        # here: on a promoted rank it seeds the fresh scheduler below.
+        shadow = sorted(self._slots.items())
         self._slots = {}
-        if basics.rank() == 0:
+        leader = basics.rank() == 0
+        promoted = leader and self.scheduler is None
+        if promoted:
+            # Seed the fresh scheduler from the shadow BEFORE the front
+            # door flips to leader role: a client re-POSTing an in-flight
+            # id during the window must join the adopted request (and see
+            # its attempts>1), not race it as a fresh admission.
+            self.scheduler = Scheduler(self.max_batch, self.max_queue,
+                                       self.cache_len)
+            if shadow:
+                adopted = self.scheduler.adopt_shadow(shadow)
+                self.log.info(
+                    "promoted to serving leader: adopted %d in-flight "
+                    "request(s) from the shadow slot table", adopted)
+        self._ensure_front_door(leader=leader)
+        if leader:
             state.serve_generation += 1
-            self._ensure_front_door()
             replayed = self.scheduler.requeue_inflight()
             if replayed:
                 self.log.info(
                     "re-formed gang (generation %d): replaying %d "
                     "in-flight request(s) from their prompts",
                     state.serve_generation, replayed)
+            self._publish_leader()
             self._drive(eng, engine)
         else:
             self._follow(eng, engine)
 
-    def _ensure_front_door(self) -> None:
-        """Create the scheduler/front door once per process — also on a
-        worker promoted to rank 0 by a re-form (its door binds a fresh
-        port; in-flight state died with the old rank 0)."""
-        if self.scheduler is None:
+    def _ensure_front_door(self, leader: bool = True) -> None:
+        """Bind this rank's front door once per process (its port is
+        stable across re-elections).  The leader's door admits into the
+        local scheduler; a follower's door forwards to the current
+        leader.  A follower promoted by a re-form flips its existing
+        door to leader role in place — clients keep the same endpoint."""
+        if leader and self.scheduler is None:
             self.scheduler = Scheduler(self.max_batch, self.max_queue,
                                        self.cache_len)
         if self._door is None:
-            self._door = FrontDoor(self.scheduler, host=self.host,
-                                   port=self.port,
-                                   timeout_s=self.request_timeout_s)
-            self.port = self._door.start()
-            self.log.info("serving front door listening on :%d",
-                          self.port)
+            self._door = FrontDoor(
+                self.scheduler if leader else None, host=self.host,
+                port=self.port,
+                timeout_s=self.request_timeout_s,
+                leader_addr_fn=self._leader_addr,
+                advertise_host=self._advertise_host())
+            door_port = self._door.start()
+            if leader:
+                self.port = door_port
+            self.log.info(
+                "serving front door listening on :%d (%s)", door_port,
+                "leader" if leader else "forwarding to leader")
             if self.on_ready is not None:
-                self.on_ready(self.port)
+                self.on_ready(door_port)
+        elif leader and self._door.scheduler is None:
+            self._door.scheduler = self.scheduler
+            self.port = self._door.port
+            self.log.info("front door :%d promoted to serving leader",
+                          self.port)
+
+    # -- leader address: publish + resolve -------------------------------
+
+    def _advertise_host(self) -> str:
+        ctx = self._elastic_ctx
+        if ctx is not None:
+            addr = ctx.kv.local_address()
+            if addr:
+                return addr
+        return "127.0.0.1"
+
+    def _leader_self_addr(self) -> str:
+        return f"{self._advertise_host()}:{self.port}"
+
+    def _publish_leader(self) -> None:
+        """Rank 0: publish this door's address under the elastic-scoped
+        KV key so follower doors (and late joiners) can resolve the
+        leader even before the first delta frame of the epoch."""
+        self._known_leader = self._leader_self_addr()
+        ctx = self._elastic_ctx
+        if ctx is not None:
+            try:
+                ctx.kv.put(ctx.key("serve/leader"), self._known_leader)
+            except Exception:
+                # KV briefly unreachable (e.g. failing over to a
+                # standby): the delta frames still carry the address.
+                self.log.warning("could not publish serving leader "
+                                 "address to the KV store")
+
+    def _leader_addr(self, refresh: bool = False) -> Optional[str]:
+        """Follower doors resolve the current leader here: the cached
+        frame-carried address normally, the KV key on ``refresh`` (a
+        forward just failed — re-election may have moved the leader)."""
+        if refresh:
+            ctx = self._elastic_ctx
+            if ctx is not None:
+                try:
+                    v = ctx.kv.get(ctx.key("serve/leader"))
+                except Exception:
+                    v = None
+                if v:
+                    self._known_leader = v
+        return self._known_leader
 
     # -- rank 0: drive ---------------------------------------------------
 
@@ -196,7 +279,7 @@ class ServingLoop:
                 seq, stopping,
                 [(slot, r.id, r.max_new, r.prompt)
                  for slot, r in admissions],
-                eng.epoch)
+                eng.epoch, leader_addr=self._known_leader or "")
             eng.serve_broadcast(payload)
             frame = eng.serve_recv(timeout=self.recv_timeout_s)
             if frame is None:  # own frame is in the inbox unless dying
@@ -232,9 +315,12 @@ class ServingLoop:
     # -- the lockstep step (identical on every rank) ---------------------
 
     def _apply_frame(self, frame, eng, engine, *, rank0: bool) -> bool:
-        seq, stopping, admissions, epoch = wire.decode_serve_delta(frame)
+        seq, stopping, admissions, epoch, leader_addr = \
+            wire.decode_serve_delta_ex(frame)
         if epoch != eng.epoch:
             return False  # stale frame from a previous incarnation
+        if leader_addr and not rank0:
+            self._known_leader = leader_addr
         if stopping:
             return True
         # Chaos: a mid-decode stall/delay on this rank, fired before any
@@ -243,7 +329,9 @@ class ServingLoop:
         t0 = time.monotonic()
         for slot, req_id, max_new, prompt in admissions:
             first = engine.prefill(slot, prompt)
-            self._slots[slot] = {"id": req_id, "remaining": max_new}
+            self._slots[slot] = {"id": req_id, "prompt": list(prompt),
+                                 "max_new": max_new,
+                                 "remaining": max_new}
             self._emit(slot, first, engine, rank0)
         if self._slots:
             toks = engine.step()

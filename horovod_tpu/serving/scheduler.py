@@ -18,10 +18,15 @@ from __future__ import annotations
 import itertools
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.telemetry import registry as _tmx
+
+# Completed requests kept around for join-by-id (a client re-POSTing an
+# id after a leader fail-over must get the finished answer, not a
+# duplicate decode).  Bounded so serving forever never grows memory.
+_RECENT_CAP = 256
 
 
 class QueueFull(Exception):
@@ -59,12 +64,31 @@ class Scheduler:
         self._slots: List[Optional[Request]] = [None] * max_batch
         self._ids = itertools.count()
         self._completed = 0
+        self._recent: "OrderedDict[str, Request]" = OrderedDict()
+
+    def _find(self, req_id: str) -> Optional[Request]:
+        """A live or recently-completed request with this id, else None.
+        Caller holds the lock."""
+        for r in self._queue:
+            if r.id == req_id:
+                return r
+        for r in self._slots:
+            if r is not None and r.id == req_id:
+                return r
+        return self._recent.get(req_id)
 
     # -- handler-thread side -------------------------------------------
 
-    def submit(self, prompt: List[int], max_new: int) -> Request:
+    def submit(self, prompt: List[int], max_new: int,
+               req_id: Optional[str] = None) -> Request:
         """Queue a request; raises ValueError on an unservable shape and
-        QueueFull when the admission queue is at its bound."""
+        QueueFull when the admission queue is at its bound.
+
+        ``req_id`` (optional, client-supplied) makes the submit
+        idempotent: when a request with that id is already queued,
+        active, or recently completed, the existing Request is returned
+        instead of a duplicate — the re-POST a client issues after a
+        leader fail-over joins the shadow-replayed original."""
         if not prompt:
             raise ValueError("prompt must be non-empty")
         if max_new < 1:
@@ -74,13 +98,46 @@ class Scheduler:
                 f"prompt + max_new_tokens ({len(prompt) + max_new}) "
                 f"exceeds the serving cache length ({self.cache_len})")
         with self._lock:
+            if req_id is not None:
+                existing = self._find(req_id)
+                if existing is not None:
+                    return existing
             if len(self._queue) >= self.max_queue:
                 raise QueueFull(
                     f"admission queue full ({self.max_queue})")
-            req = Request(f"r{next(self._ids)}", list(prompt), max_new)
+            req = Request(req_id or f"r{next(self._ids)}",
+                          list(prompt), max_new)
             self._queue.append(req)
             _tmx.set_gauge("hvd_serve_queue_depth", len(self._queue))
         return req
+
+    # -- leader fail-over (promoted rank) -------------------------------
+
+    def adopt_shadow(self, entries: List[Tuple[int, Dict]]) -> int:
+        """Seed a fresh scheduler (on a worker just promoted to rank 0)
+        with the dead leader's in-flight slot table, reconstructed from
+        the broadcast delta frames: ``entries`` is a ``(slot, {"id",
+        "prompt", "max_new", ...})`` list.  Each becomes a queued
+        Request with ``attempts=1`` — the lost incarnation's decode was
+        attempt 1, so the replay the new leader admits reports
+        ``attempts >= 2`` (at-least-once, like requeue_inflight).
+        Returns how many were adopted."""
+        adopted = 0
+        with self._lock:
+            for slot, st in sorted(entries, key=lambda e: e[0]):
+                if self._find(st["id"]) is not None:
+                    continue  # already known (e.g. client re-POST won)
+                req = Request(st["id"], list(st["prompt"]),
+                              int(st["max_new"]))
+                req.attempts = 1
+                self._queue.append(req)
+                adopted += 1
+            if adopted:
+                _tmx.set_gauge("hvd_serve_queue_depth", len(self._queue))
+        for _ in range(adopted):
+            _tmx.inc_counter("hvd_serve_requests_total",
+                             labels=("replayed",))
+        return adopted
 
     # -- serving-loop side ---------------------------------------------
 
@@ -123,6 +180,9 @@ class Scheduler:
             assert req is not None, f"complete() on empty slot {slot}"
             self._slots[slot] = None
             self._completed += 1
+            self._recent[req.id] = req
+            while len(self._recent) > _RECENT_CAP:
+                self._recent.popitem(last=False)
             _tmx.set_gauge("hvd_serve_batch_occupancy",
                            self.active_count())
         _tmx.inc_counter("hvd_serve_requests_total", labels=("ok",))

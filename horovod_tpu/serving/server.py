@@ -1,4 +1,4 @@
-"""Rank-0 HTTP front door for the serving gang.
+"""Per-rank HTTP front door for the serving gang.
 
 Same ThreadingHTTPServer shape as the metrics debug server
 (telemetry/server.py) and the rendezvous server: HTTP/1.1 keep-alive,
@@ -6,19 +6,31 @@ silent request logging, chaos-shed hook first.  ``POST /generate``
 blocks the handler thread until the scheduler completes (or fails) the
 request; ``GET /stats`` and ``GET /health`` answer immediately.
 
+Every rank runs one door for the life of the process; its role is
+dynamic.  On the leader (``door.scheduler`` set) requests are admitted
+locally.  On followers (``door.scheduler is None``) the door is a thin
+forwarding proxy: the body is relayed to the current leader's door
+(address learned from the serve-delta frames / the elastic-scoped KV
+key) and the answer streamed back — so clients keep one stable
+endpoint per rank across leader re-elections.
+
 Shedding is explicit and typed: the ``serve.admit`` chaos site or a
 full admission queue answers 503 (the client's signal to back off or
 go to another replica), a malformed body 400, and a request that
 outlives ``timeout_s`` 504 — the handler gives up, the request itself
-stays admitted (at-least-once, not exactly-once).
+stays admitted (at-least-once, not exactly-once).  A follower whose
+leader is unknown or unreachable also answers 503 — retryable, the
+re-election publishes a fresh address within the client's backoff.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.error
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.serving.scheduler import QueueFull, Scheduler
@@ -27,8 +39,7 @@ from horovod_tpu.telemetry import registry as _tmx
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
-    scheduler: Scheduler = None  # class attrs installed by FrontDoor
-    timeout_s: float = 120.0
+    door: "FrontDoor" = None  # class attr installed by FrontDoor
 
     def log_message(self, fmt, *args):  # silence request logging
         pass
@@ -61,7 +72,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, b"ok", "text/plain")
             return
         if self.path == "/stats":
-            self._send_json(200, self.scheduler.stats())
+            scheduler = self.door.scheduler
+            if scheduler is None:
+                self._send_json(200, {
+                    "role": "follower",
+                    "leader": self.door.leader_addr() or None,
+                })
+                return
+            stats = scheduler.stats()
+            stats["role"] = "leader"
+            self._send_json(200, stats)
             return
         self._send(404, b"", "text/plain")
 
@@ -71,18 +91,27 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/generate":
             self._send(404, b"", "text/plain")
             return
+        n = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(n)
+        scheduler = self.door.scheduler
+        if scheduler is None:
+            self._forward(raw)
+            return
         try:
-            n = int(self.headers.get("Content-Length", "0"))
-            body = json.loads(self.rfile.read(n) or b"{}")
+            body = json.loads(raw or b"{}")
             prompt = [int(t) for t in body["prompt"]]
             max_new = int(body.get("max_new_tokens", 16))
+            req_id = body.get("id")
+            if req_id is not None and (not isinstance(req_id, str)
+                                       or not req_id):
+                raise ValueError("id must be a non-empty string")
         except (ValueError, KeyError, TypeError, json.JSONDecodeError):
             _tmx.inc_counter("hvd_serve_requests_total",
                              labels=("error",))
             self._send_json(400, {"error": "bad request body"})
             return
         try:
-            req = self.scheduler.submit(prompt, max_new)
+            req = scheduler.submit(prompt, max_new, req_id=req_id)
         except QueueFull as e:
             _tmx.inc_counter("hvd_serve_requests_total",
                              labels=("shed",))
@@ -93,7 +122,7 @@ class _Handler(BaseHTTPRequestHandler):
                              labels=("error",))
             self._send_json(400, {"error": str(e)})
             return
-        if not req.done.wait(self.timeout_s):
+        if not req.done.wait(self.door.timeout_s):
             _tmx.inc_counter("hvd_serve_requests_total",
                              labels=("error",))
             self._send_json(504, {"error": "request timed out",
@@ -116,23 +145,91 @@ class _Handler(BaseHTTPRequestHandler):
             "latency_ms": round((now - req.t_submit) * 1e3, 3),
         })
 
+    # -- follower: proxy to the current leader --------------------------
+
+    def _forward(self, raw: bytes) -> None:
+        """Relay the POST body to the leader's /generate and stream the
+        answer back.  One refresh+retry on a dead leader address (the
+        re-elected leader republishes under the KV key); still
+        unreachable -> 503, the retryable answer."""
+        addr = self.door.leader_addr()
+        for attempt in (0, 1):
+            if attempt:
+                addr = self.door.leader_addr(refresh=True)
+            if not addr or addr == self.door.advertised_addr():
+                # Unknown leader, or a stale pointer at ourselves while
+                # we hold no scheduler: nothing to proxy to yet.
+                continue
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/generate", data=raw, method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self.door.timeout_s) as r:
+                    self._send(r.status, r.read(),
+                               r.headers.get("Content-Type",
+                                             "application/json"))
+                return
+            except urllib.error.HTTPError as e:
+                # The leader answered (400/503/...): relay its verdict.
+                self._send(e.code, e.read(),
+                           e.headers.get("Content-Type",
+                                         "application/json"))
+                return
+            except (urllib.error.URLError, ConnectionError, OSError):
+                continue
+        _tmx.inc_counter("hvd_serve_requests_total", labels=("shed",))
+        self._send_json(503, {"error": "serving leader unreachable; "
+                                       "retry after re-election"})
+
 
 class FrontDoor:
-    """Threaded /generate endpoint on rank 0; ``start()`` returns the
-    bound port.  Survives gang re-forms — the scheduler (and the
+    """Threaded /generate endpoint, one per rank; ``start()`` returns
+    the bound port.  Survives gang re-forms — the scheduler (and the
     handler threads parked on request Events) belong to the process,
-    not to an engine incarnation."""
+    not to an engine incarnation.  ``scheduler`` is mutable: flipping it
+    from None to a live Scheduler promotes the door from forwarding
+    follower to admitting leader (and back is never needed — a demoted
+    leader is a dead process).
 
-    def __init__(self, scheduler: Scheduler, *, host: str = "0.0.0.0",
-                 port: int = 0, timeout_s: float = 120.0):
-        handler = type("_BoundHandler", (_Handler,),
-                       {"scheduler": scheduler, "timeout_s": timeout_s})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+    ``leader_addr_fn(refresh)``: returns the current leader's
+    ``host:port`` or None; ``refresh=True`` asks for an authoritative
+    re-read (the KV key) rather than the frame-cached value."""
+
+    def __init__(self, scheduler: Optional[Scheduler], *,
+                 host: str = "0.0.0.0", port: int = 0,
+                 timeout_s: float = 120.0,
+                 leader_addr_fn:
+                 Optional[Callable[..., Optional[str]]] = None,
+                 advertise_host: str = "127.0.0.1"):
+        self.scheduler = scheduler
+        self.timeout_s = timeout_s
+        self._leader_addr_fn = leader_addr_fn
+        self._advertise_host = advertise_host
+        handler = type("_BoundHandler", (_Handler,), {"door": self})
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), handler)
+        except OSError:
+            if port == 0:
+                raise
+            # Configured port taken (several ranks of one host): an
+            # ephemeral port keeps the door up; the launcher/KV carries
+            # the real address to clients.
+            self._httpd = ThreadingHTTPServer((host, 0), handler)
+        self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    def leader_addr(self, refresh: bool = False) -> Optional[str]:
+        if self._leader_addr_fn is None:
+            return None
+        return self._leader_addr_fn(refresh=refresh)
+
+    def advertised_addr(self) -> str:
+        return f"{self._advertise_host}:{self.port}"
 
     def start(self) -> int:
         self._thread = threading.Thread(

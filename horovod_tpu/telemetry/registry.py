@@ -123,6 +123,10 @@ KNOWN_METRICS: Dict[str, dict] = {
         "Current elastic membership epoch."),
     "hvd_elastic_reforms_total": _counter(
         "Successful elastic gang re-forms."),
+    "hvd_leader_failovers_total": _counter(
+        "Re-forms triggered by the death of rank 0 (the star "
+        "coordinator / serving leader); the lowest surviving rank "
+        "is promoted."),
     "hvd_nonfinite_skips_total": _counter(
         "Steps skipped by the agreed non-finite gradient guard."),
     # -- straggler detection (telemetry/straggler.py) --

@@ -64,6 +64,9 @@ KV_RETRIES = "HVD_KV_RETRIES"
 KV_TIMEOUT = "HVD_KV_TIMEOUT"
 KV_RETRY_BASE_S = "HVD_KV_RETRY_BASE_S"
 KV_RETRY_MAX_S = "HVD_KV_RETRY_MAX_S"
+# Ordered rendezvous endpoint list "host:port,host:port" (primary
+# first, warm standbys after); unset = single HVD_RENDEZVOUS_ADDR/PORT.
+KV_ADDRS = "HVD_KV_ADDRS"
 # Launcher host blacklist (relaunch path).
 BLACKLIST_THRESHOLD = "HVD_BLACKLIST_THRESHOLD"
 BLACKLIST_COOLDOWN_S = "HVD_BLACKLIST_COOLDOWN_S"

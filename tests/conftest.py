@@ -162,6 +162,49 @@ def pytest_runtest_call(item):
         signal.signal(signal.SIGALRM, old_handler)
 
 
+# -- session-end leak sweep ---------------------------------------------
+# Gang tests that tear down badly leave three kinds of debris: /dev/shm
+# segments from the intra-host transport, persistent sender threads
+# (hvd-send-*), and KV servers (hvd-kv-*: launcher standbys / the
+# http_server CLI).  Any of these surviving the whole session means some
+# test leaked them; fail loudly instead of letting the debris poison the
+# next run (or fill /dev/shm on CI).
+
+
+def _leaked_threads():
+    return sorted(
+        t.name for t in threading.enumerate()
+        if t.is_alive() and (t.name.startswith("hvd-send-")
+                             or t.name.startswith("hvd-kv-")))
+
+
+def _shm_segments():
+    import glob
+
+    return sorted(glob.glob("/dev/shm/hvd-shm-*"))
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _leak_sweep():
+    import time
+
+    preexisting = set(_shm_segments())
+    yield
+    # Grace window: teardown of the last test may still be unwinding its
+    # daemon threads / unlinking segments.
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        threads = _leaked_threads()
+        segs = [s for s in _shm_segments() if s not in preexisting]
+        if not threads and not segs:
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        "leak sweep: gang debris survived the session — "
+        f"threads={threads} shm={segs} (a test leaked a sender thread, "
+        "standby KV server, or shm segment)")
+
+
 @pytest.fixture(scope="session")
 def jax():
     import jax as _jax

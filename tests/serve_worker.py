@@ -96,7 +96,10 @@ def main():
         port=0, host="127.0.0.1", cache_len=cache_len, eos_id=None,
         request_timeout_s=90.0, on_ready=on_ready)
 
-    if expect and hvd.rank() == 0:
+    # loop.scheduler is non-None only on the current serving leader —
+    # rank 0 at start, or a rank promoted by leader fail-over — so the
+    # stopper arms on every rank and fires only where requests complete.
+    if expect:
         def stopper():
             while True:
                 sch = loop.scheduler

@@ -1,0 +1,56 @@
+"""Bench-throughput regression gate as a test: the newest ``BENCH_r*``
+snapshot must not drop any shared ``*_per_sec`` metric by more than 20%
+vs the previous round (tools/check_bench_regression.py)."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_bench_regression as cbr  # noqa: E402
+
+
+def test_latest_round_has_no_regression():
+    if len(cbr.bench_files()) < 2:
+        pytest.skip("fewer than 2 BENCH_r*.json snapshots — nothing to compare")
+    problems = cbr.check()
+    assert not problems, "\n".join(problems)
+
+
+def _write(root, n, metrics):
+    (root / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "rc": 0, "parsed": metrics}))
+
+
+def test_detects_throughput_drop(tmp_path):
+    _write(tmp_path, 1, {"x_per_sec": 100.0, "lat_ms": 5.0})
+    _write(tmp_path, 2, {"x_per_sec": 70.0, "lat_ms": 50.0})
+    problems = cbr.check(root=tmp_path)
+    assert len(problems) == 1 and "x_per_sec" in problems[0]
+    # Latency is not gated; within tolerance passes.
+    _write(tmp_path, 2, {"x_per_sec": 85.0, "lat_ms": 50.0})
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_compares_newest_two_only_and_ignores_unshared(tmp_path):
+    _write(tmp_path, 1, {"x_per_sec": 1000.0})
+    _write(tmp_path, 2, {"x_per_sec": 100.0, "gone_per_sec": 9.0})
+    _write(tmp_path, 3, {"x_per_sec": 99.0, "new_per_sec": 1.0})
+    # r2->r3 is fine; the r1->r2 cliff is history, unshared keys skipped.
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_tail_fallback_when_parsed_missing(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "tail": 'noise\n{"x_per_sec": 100.0}\n'}))
+    _write(tmp_path, 2, {"x_per_sec": 50.0})
+    problems = cbr.check(root=tmp_path)
+    assert len(problems) == 1 and "x_per_sec" in problems[0]
+
+
+def test_single_snapshot_is_a_pass(tmp_path):
+    _write(tmp_path, 1, {"x_per_sec": 100.0})
+    assert cbr.check(root=tmp_path) == []

@@ -337,11 +337,18 @@ def test_elastic_discovery_script_triggers_reform(tmp_path):
     under epoch 1 — exactly once: the restarted driver's baseline poll
     must not re-trigger."""
     marker = tmp_path / "hostC.up"
+    polled = tmp_path / "driver.polled"
     script = tmp_path / "discover.sh"
+    # The marker is read BEFORE the poll stamp is written: once the test
+    # sees the stamp, a marker it writes can only be picked up by a
+    # *later* poll — the driver's baseline snapshot deterministically
+    # excludes hostC no matter how slow worker startup was.
     script.write_text("#!/bin/sh\n"
+                      f"if [ -f {marker} ]; then c=1; else c=0; fi\n"
                       "echo hostA\n"
                       "echo hostB\n"
-                      f"if [ -f {marker} ]; then echo hostC; fi\n")
+                      f"touch {polled}\n"
+                      "if [ $c = 1 ]; then echo hostC; fi\n")
     script.chmod(0o755)
 
     server = RendezvousServer("127.0.0.1")
@@ -376,7 +383,10 @@ def test_elastic_discovery_script_triggers_reform(tmp_path):
             procs.append(subprocess.Popen(
                 [sys.executable, WORKER], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE))
-        time.sleep(1.5)
+        deadline = time.time() + 60
+        while not polled.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert polled.exists(), "discovery driver never polled"
         marker.write_text("up\n")
         outs = []
         for p in procs:

@@ -1,0 +1,208 @@
+"""Replicated rendezvous KV: endpoint-list parsing, launcher
+validation, deterministic failover order, write-through mirroring, and
+standby catch-up (docs/fault_tolerance.md "Surviving rank 0")."""
+
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner import config_parser
+from horovod_tpu.runner import run as run_mod
+from horovod_tpu.runner.http_client import KVClient, parse_kv_addrs
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.utils import env as env_util
+
+
+# -- parse_kv_addrs -----------------------------------------------------
+
+def test_parse_kv_addrs_happy_path():
+    assert parse_kv_addrs("h1:9000") == [("h1", 9000)]
+    assert parse_kv_addrs(" h1:9000 , h2:9001 ,h3:1") == [
+        ("h1", 9000), ("h2", 9001), ("h3", 1)]
+
+
+@pytest.mark.parametrize("bad,needle", [
+    ("", "empty"),
+    ("h1:9000,,h2:9001", "empty entry"),
+    ("h1", "not host:port"),
+    (":9000", "not host:port"),
+    ("h1:port", "non-numeric"),
+    ("h1:0", "outside 1..65535"),
+    ("h1:70000", "outside 1..65535"),
+    ("h1:-1", "outside 1..65535"),
+])
+def test_parse_kv_addrs_rejects_malformed(bad, needle):
+    with pytest.raises(ValueError) as ei:
+        parse_kv_addrs(bad)
+    assert needle in str(ei.value), str(ei.value)
+
+
+# -- launcher CLI validation (exit 2, no worker spawned) ----------------
+
+def test_cli_kv_addrs_malformed_exit2(capsys):
+    rc = run_mod.run_commandline(
+        ["-np", "1", "--kv-addrs", "h1:9000,oops",
+         "python", "-c", "pass"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--kv-addrs" in err and "not host:port" in err, err
+
+
+def test_cli_kv_standbys_range_exit2(capsys):
+    for bad in ("-1", "3"):
+        rc = run_mod.run_commandline(
+            ["-np", "1", "--kv-standbys", bad, "python", "-c", "pass"])
+        assert rc == 2, bad
+        assert "--kv-standbys" in capsys.readouterr().err
+
+
+def test_cli_kv_addrs_standbys_mutually_exclusive_exit2(capsys):
+    rc = run_mod.run_commandline(
+        ["-np", "1", "--kv-standbys", "1", "--kv-addrs", "h:1",
+         "python", "-c", "pass"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--kv-standbys" in err and "--kv-addrs" in err
+
+
+def test_cli_kv_addrs_env_mapping():
+    args = run_mod.make_parser().parse_args(
+        ["-np", "2", "--kv-addrs", "h1:9000,h2:9001", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env[env_util.KV_ADDRS] == "h1:9000,h2:9001"
+
+
+# -- client endpoint behavior -------------------------------------------
+
+def test_client_single_address_identical_to_seed(monkeypatch):
+    # Without HVD_KV_ADDRS the constructor args are the single endpoint,
+    # exactly as before the endpoint-list feature existed.
+    monkeypatch.delenv(env_util.KV_ADDRS, raising=False)
+    c = KVClient("hostX", 1234)
+    assert c.endpoints == [("hostX", 1234)]
+    assert (c.host, c.port) == ("hostX", 1234)
+    c._rotate_endpoint()  # single endpoint: rotation is a no-op
+    assert (c.host, c.port) == ("hostX", 1234)
+
+
+def test_client_env_list_overrides_and_rotates_deterministically(
+        monkeypatch):
+    monkeypatch.setenv(env_util.KV_ADDRS, "p:1,s1:2,s2:3")
+    c = KVClient("ignored", 9999)
+    assert c.endpoints == [("p", 1), ("s1", 2), ("s2", 3)]
+    seen = []
+    for _ in range(6):
+        seen.append((c.host, c.port))
+        c._rotate_endpoint()
+    # Primary first, standbys in listed order, wrap — same every time.
+    assert seen == [("p", 1), ("s1", 2), ("s2", 3)] * 2
+
+
+def test_client_fails_over_to_standby(monkeypatch):
+    primary = RendezvousServer(host="127.0.0.1", secret="s3")
+    primary.start()
+    standby = RendezvousServer(host="127.0.0.1", secret="s3")
+    standby.start()
+    try:
+        primary.set_mirrors([("127.0.0.1", standby.port)])
+        monkeypatch.setenv(
+            env_util.KV_ADDRS,
+            f"127.0.0.1:{primary.port},127.0.0.1:{standby.port}")
+        monkeypatch.setenv("HVD_KV_RETRY_BASE_S", "0.01")
+        c = KVClient("127.0.0.1", primary.port, secret="s3")
+        c.put("k", b"v1")           # mirrored to the standby
+        primary.stop()              # kill the primary mid-conversation
+        assert c.get_bytes("k") == b"v1"  # retry loop rotated to the standby
+        assert (c.host, c.port) == ("127.0.0.1", standby.port)
+        c.put("k2", b"v2")          # sticky: still on the live standby
+        assert c.get_bytes("k2") == b"v2"
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+# -- server mirroring + catch-up ----------------------------------------
+
+def test_mirror_write_through_and_delete():
+    primary = RendezvousServer(host="127.0.0.1", secret="sX")
+    primary.start()
+    standby = RendezvousServer(host="127.0.0.1", secret="sX")
+    standby.start()
+    try:
+        primary.set_mirrors([("127.0.0.1", standby.port)])
+        c = KVClient("127.0.0.1", primary.port, secret="sX")
+        c.put("a", b"1")
+        c.put("b", b"2")
+        sc = KVClient("127.0.0.1", standby.port, secret="sX")
+        assert sc.get_bytes("a") == b"1" and sc.get_bytes("b") == b"2"
+        c.delete("a")
+        assert sc.get_bytes("a") is None and sc.get_bytes("b") == b"2"
+    finally:
+        primary.stop()
+        standby.stop()
+
+
+def test_kvsync_catchup_and_auth():
+    primary = RendezvousServer(host="127.0.0.1", secret="sY")
+    primary.start()
+    late = RendezvousServer(host="127.0.0.1", secret="sY")
+    late.start()
+    try:
+        c = KVClient("127.0.0.1", primary.port, secret="sY")
+        c.put("k1", b"\x00bin")
+        c.put("k2", b"two")
+        # A standby started late bulk-syncs the full store.
+        assert late.sync_from("127.0.0.1", primary.port)
+        lc = KVClient("127.0.0.1", late.port, secret="sY")
+        assert lc.get_bytes("k1") == b"\x00bin" and lc.get_bytes("k2") == b"two"
+        # Unsigned /kvsync is rejected; store untouched on failure.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{primary.port}/kvsync")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+        assert not late.sync_from("127.0.0.1", 1)  # unreachable -> False
+        assert lc.get_bytes("k1") == b"\x00bin"
+    finally:
+        primary.stop()
+        late.stop()
+
+
+def test_kv_mirror_chaos_dropped_forward_absorbed():
+    from horovod_tpu.common import fault_injection as fi
+
+    primary = RendezvousServer(host="127.0.0.1", secret="sC")
+    primary.start()
+    standby = RendezvousServer(host="127.0.0.1", secret="sC")
+    standby.start()
+    try:
+        primary.set_mirrors([("127.0.0.1", standby.port)])
+        fi.configure({"faults": [
+            {"site": "kv.mirror", "kind": "error", "times": 1}]})
+        c = KVClient("127.0.0.1", primary.port, secret="sC")
+        c.put("torn", b"1")   # forward chaos-dropped; PUT still durable
+        c.put("ok", b"2")     # next forward flows again
+        assert c.get_bytes("torn") == b"1"
+        sc = KVClient("127.0.0.1", standby.port, secret="sC")
+        assert sc.get_bytes("torn") is None
+        assert sc.get_bytes("ok") == b"2"
+        # /kvsync is the repair path for the torn entry.
+        assert standby.sync_from("127.0.0.1", primary.port)
+        assert sc.get_bytes("torn") == b"1"
+    finally:
+        fi.clear()
+        primary.stop()
+        standby.stop()
+
+
+def test_mirror_failure_does_not_break_primary():
+    primary = RendezvousServer(host="127.0.0.1", secret="sZ")
+    primary.start()
+    try:
+        # Mirror points at a dead port: writes must still succeed.
+        primary.set_mirrors([("127.0.0.1", 1)])
+        c = KVClient("127.0.0.1", primary.port, secret="sZ")
+        c.put("k", b"v")
+        assert c.get_bytes("k") == b"v"
+    finally:
+        primary.stop()

@@ -63,6 +63,12 @@ def test_pipeline_microbatch_count(jax, eight_devices):
 def test_pipeline_composes_with_tp_dp(jax, eight_devices):
     # pp2 × tp2 × dp2: Megatron sharding + data parallel stay GSPMD-auto
     # inside the manual-pp shard_map.
+    if not hasattr(jax, "shard_map"):
+        # pre-0.5 partial-auto lowers the pp ring's collectives to a
+        # PartitionId instruction XLA's SPMD partitioner rejects; the
+        # pp-only composition (no auto axes) is covered above.
+        pytest.skip("partial-auto shard_map + in-body collectives "
+                    "unsupported on this jax")
     cfg = _cfg()
     mesh = mesh_mod.make_mesh({"dp": 2, "tp": 2, "pp": 2},
                               devices=eight_devices)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Lint: the newest bench round must not regress throughput.
+
+Compares the two most recent ``BENCH_r*.json`` snapshots at the repo
+root (ordered by round number) and fails when any **shared** throughput
+metric — a key ending in ``_per_sec`` — dropped by more than the
+tolerance (default 20%).  Latency metrics (``*_ms``) are noisy in CI and
+direction-ambiguous across workload changes, so only throughput gates.
+
+Metrics present in one round but not the other are reported as info and
+ignored: benchmarks grow with the repo and a new metric has no baseline
+yet, while a removed one has nothing to compare against.
+
+Usage: ``python tools/check_bench_regression.py [--tolerance 0.2]``
+(exit 1 on regression, 0 otherwise — including when fewer than two
+snapshots exist, since there is nothing to compare).  Wired into the
+suite as ``tests/test_bench_regression.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def bench_files(root: Path = REPO_ROOT) -> List[Tuple[int, Path]]:
+    """All round snapshots as (round, path), ascending by round."""
+    out = []
+    for p in root.glob("BENCH_r*.json"):
+        m = _ROUND_RE.match(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out)
+
+
+def load_metrics(path: Path) -> Dict[str, float]:
+    """Numeric metrics from one snapshot (the ``parsed`` dict, falling
+    back to the last JSON line of ``tail`` for older capture formats)."""
+    doc = json.loads(path.read_text())
+    parsed = doc.get("parsed")
+    if not isinstance(parsed, dict):
+        parsed = {}
+        for line in reversed(doc.get("tail", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    parsed = {}
+                break
+    return {k: float(v) for k, v in parsed.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
+    """Return regression messages (empty = pass or nothing to compare)."""
+    files = bench_files(root)
+    if len(files) < 2:
+        print(f"check_bench_regression: {len(files)} snapshot(s); "
+              "need 2 to compare — skipping")
+        return []
+    (old_n, old_p), (new_n, new_p) = files[-2], files[-1]
+    old, new = load_metrics(old_p), load_metrics(new_p)
+    old_tp = {k for k in old if k.endswith("_per_sec")}
+    new_tp = {k for k in new if k.endswith("_per_sec")}
+    for k in sorted(old_tp - new_tp):
+        print(f"  info: {k} present in r{old_n} but not r{new_n}")
+    for k in sorted(new_tp - old_tp):
+        print(f"  info: {k} new in r{new_n} (no baseline)")
+    problems = []
+    for k in sorted(old_tp & new_tp):
+        if old[k] <= 0:
+            continue
+        ratio = new[k] / old[k]
+        marker = "REGRESSION" if ratio < 1.0 - tolerance else "ok"
+        print(f"  {marker}: {k}: r{old_n}={old[k]:g} -> r{new_n}={new[k]:g} "
+              f"({(ratio - 1.0) * 100:+.1f}%)")
+        if ratio < 1.0 - tolerance:
+            problems.append(
+                f"{k} dropped {(1.0 - ratio) * 100:.1f}% "
+                f"(r{old_n}={old[k]:g} -> r{new_n}={new[k]:g}, "
+                f"tolerance {tolerance * 100:.0f}%)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tolerance", type=float, default=0.2,
+                    help="allowed fractional drop (default 0.2 = 20%%)")
+    args = ap.parse_args(argv)
+    problems = check(tolerance=args.tolerance)
+    for msg in problems:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
