@@ -260,4 +260,44 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
   return !rd.fail;
 }
 
+// -- recovery-ladder framing (HVD_WIRE_CRC=1; see wire.h) --------------
+
+namespace {
+
+// Table-driven CRC-32, reflected polynomial 0xEDB88320 (the zlib/IEEE
+// CRC) — must produce exactly Python's zlib.crc32 for the same bytes.
+const uint32_t* Crc32Table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+}  // namespace
+
+uint32_t WireCrc32(const uint8_t* data, size_t len, uint32_t crc) {
+  const uint32_t* table = Crc32Table();
+  crc = ~crc;
+  for (size_t i = 0; i < len; ++i)
+    crc = table[(crc ^ data[i]) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t DataCrc(const uint8_t* payload, size_t len, uint32_t seq) {
+  uint32_t crc = WireCrc32(payload, len, 0);
+  uint8_t s[4] = {static_cast<uint8_t>(seq & 0xff),
+                  static_cast<uint8_t>((seq >> 8) & 0xff),
+                  static_cast<uint8_t>((seq >> 16) & 0xff),
+                  static_cast<uint8_t>((seq >> 24) & 0xff)};
+  return WireCrc32(s, 4, crc);
+}
+
 }  // namespace hvd

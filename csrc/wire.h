@@ -111,4 +111,32 @@ bool DecodeResponseList(const uint8_t* data, size_t len,
                         std::vector<std::string>* resend_names,
                         WireParams* params, uint32_t* epoch = nullptr);
 
+// -- recovery-ladder framing (HVD_WIRE_CRC=1; wire.py mirror) ----------
+//
+// Control tags 11-13 are reserved by the Python engine's data-plane
+// recovery ladder (utils/ladder.py): kTagNack = 11 (u32 expected_seq),
+// kTagResume = 12 and kTagFailover = 13 (i32 rank, u32 expected_seq,
+// u32 epoch).  On a CRC-armed link every kTagData frame additionally
+// ends with an 8-byte trailer INSIDE the frame payload:
+//
+//   DataTrailer := u32 seq, u32 crc
+//   crc = CRC-32 (reflected polynomial 0xEDB88320, the zlib/IEEE one)
+//         over the payload bytes, then over the 4 LE seq bytes.
+//
+// The native engine does not implement the ladder yet; it MUST NOT join
+// a gang running HVD_WIRE_CRC=1 (the knob is rejected at Engine
+// construction, like HVD_COLLECTIVE_TIMEOUT is ignored).  WireCrc32 is
+// provided so the future native path validates identically to
+// wire.py's data_crc().
+constexpr uint8_t kTagNack = 11;
+constexpr uint8_t kTagResume = 12;
+constexpr uint8_t kTagFailover = 13;
+constexpr size_t kDataTrailerBytes = 8;
+
+// CRC-32 (zlib polynomial), seed 0 — matches Python's zlib.crc32.
+uint32_t WireCrc32(const uint8_t* data, size_t len, uint32_t crc = 0);
+
+// crc-over-payload-then-seq, exactly wire.py data_crc().
+uint32_t DataCrc(const uint8_t* payload, size_t len, uint32_t seq);
+
 }  // namespace hvd

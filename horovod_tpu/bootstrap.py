@@ -31,8 +31,8 @@ def bootstrap_mesh(
     rdv_addr: str,
     rdv_port: int,
     shm_capable: bool = False,
-) -> Tuple[Dict[int, socket.socket], Optional[socket.socket],
-           Dict[int, socket.socket], object, str]:
+    keep_listener: bool = False,
+):
     """Returns ``(data, ctrl_sock, ctrl_socks, kv, prefix)``:
 
     * ``data``: peer rank -> connected data socket (full mesh),
@@ -46,6 +46,12 @@ def bootstrap_mesh(
     Python engine) publish a matching same-host fingerprint; everyone
     else (native engine) publishes a rank-unique token so peers always
     pair with them over TCP.
+
+    ``keep_listener=True`` (recovery-ladder mode, ``HVD_WIRE_CRC=1``)
+    appends ``(peers, listener)`` to the return tuple instead of closing
+    the listener: ``peers`` maps rank -> advertised ``(host, port)`` and
+    the still-open listener accepts rung-2 reconnect re-dials for the
+    life of the gang (utils/ladder.py ``ReconnectListener``).
     """
     from horovod_tpu.runner.http_client import KVClient
     from horovod_tpu.utils import transport as tpt
@@ -129,5 +135,7 @@ def bootstrap_mesh(
             data[peer_rank] = s
         else:
             ctrl_socks[peer_rank] = s
+    if keep_listener:
+        return data, ctrl_sock, ctrl_socks, kv, prefix, peers, listener
     listener.close()
     return data, ctrl_sock, ctrl_socks, kv, prefix

@@ -9,7 +9,9 @@ probabilistic (``prob`` under a fixed ``seed``) — both deterministic, so
 multi-process chaos scenarios replay exactly.
 
 The plan comes from the ``HOROVOD_FAULT_PLAN`` environment variable
-(inline JSON, or a path to a JSON file) or from :func:`configure`.  With
+(inline JSON, a path to a JSON file, or the seedable
+``random:<seed>:<rate>`` shorthand that sweeps the transient data-plane
+fault kinds — see :func:`random_schedule`) or from :func:`configure`.  With
 no plan set, every :func:`fire` call is a single module-global ``None``
 check — no allocation, no locking, no time lookup — so production code
 pays nothing for carrying the hooks (pinned by tests/test_chaos.py).
@@ -84,6 +86,9 @@ KNOWN_SITES = {
     "ctrl.coord.send": "coordinator->worker control send",
     "sock.stall": "data-plane ring-hop receive (hang simulation)",
     "sock.halfopen": "persistent sender thread send (half-open sim)",
+    "sock.corrupt": "flip one wire byte of a ladder data frame (CRC)",
+    "sock.reset": "hard-reset a ladder data socket mid-collective",
+    "shm.lost": "shm ring faults mid-gang (reader gone / attach lost)",
     "shm.stall": "data-plane shm ring receive (hang simulation)",
     "shm.attach": "shm segment attach during transport pairing",
     "train.step": "user-level per-step site (training scripts)",
@@ -219,11 +224,38 @@ def active() -> bool:
     return _PLAN is not None
 
 
+# The transient fault kinds the `random:` schedule sweeps — exactly the
+# faults the recovery ladder (docs/fault_tolerance.md) must self-heal
+# without an eviction.  sock.corrupt is a `corrupt` kind (the ladder
+# sender flips a wire byte); the other two are `error` kinds whose
+# InjectedFault the ladder treats as a dead socket / dead segment.
+RANDOM_SCHEDULE_FAULTS = (
+    ("sock.corrupt", "corrupt"),
+    ("sock.reset", "error"),
+    ("shm.lost", "error"),
+)
+
+
+def random_schedule(seed: int, rate: float) -> dict:
+    """Expand ``random:<seed>:<rate>`` into a plan spec: each transient
+    fault kind fires independently with probability ``rate`` per pass,
+    from one PRNG seeded with ``seed`` — deterministic, so a chaos soak
+    replays exactly under the same plan string."""
+    return {"seed": int(seed), "faults": [
+        {"site": site, "kind": kind, "prob": float(rate)}
+        for site, kind in RANDOM_SCHEDULE_FAULTS]}
+
+
 def _load_from_env() -> None:
     raw = os.environ.get(ENV_VAR)
     if not raw:
         return
     raw = raw.strip()
+    if raw.startswith("random:"):
+        # Seedable randomized chaos soak: "random:<seed>:<rate>".
+        _, seed, rate = raw.split(":")
+        configure(random_schedule(int(seed), float(rate)))
+        return
     if not raw.startswith("{"):
         with open(raw) as fh:
             raw = fh.read()

@@ -68,11 +68,32 @@ reservation is noted in csrc/wire.h):
   AbortReport  := varstr tensor_name, i32 suspect_rank, u32 epoch
   ProbeAck     := u8 busy, f64 busy_seconds, u32 epoch
   AbortVerdict := varstr tensor_name, u32 n, i32 ranks[n], u32 epoch
+
+Recovery-ladder framing (``HVD_WIRE_CRC=1`` only — docs/fault_tolerance.md
+"recovery ladder"; tag numbers 11-13 and the trailer layout are reserved
+in csrc/wire.h, which the native engine must mirror before it can join a
+CRC-armed gang):
+
+  DataTrailer := u32 seq, u32 crc        # appended to every data frame;
+                                         # crc = CRC-32 (zlib polynomial
+                                         # 0xEDB88320) over payload, then
+                                         # over the 4 seq bytes
+  Nack        := u32 expected_seq        # TAG_NACK: receiver -> sender
+  Resume      := i32 rank, u32 expected_seq, u32 epoch   # TAG_RESUME
+  Failover    := i32 rank, u32 expected_seq, u32 epoch   # TAG_FAILOVER
+
+The trailer rides INSIDE the frame payload (header length includes it),
+so CRC-off peers and CRC-on peers are wire-incompatible by construction
+— the knob must be gang-wide, like ``HVD_COLLECTIVE_TIMEOUT``.  CRC-32
+with the zlib polynomial is the deliberate checksum choice: zlib.crc32
+runs at C speed in every CPython (no extra dependency), and the csrc
+mirror uses the same table-driven polynomial.
 """
 
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import List, Optional, Tuple
 
 from horovod_tpu.common.types import (
@@ -386,6 +407,74 @@ def decode_abort_verdict(data: bytes) -> Tuple[str, List[int], int]:
         ranks.append(r)
     (epoch,) = struct.unpack_from("<I", data, off)
     return name, ranks, epoch
+
+
+# -- recovery-ladder framing (docs/fault_tolerance.md) ------------------
+#
+# Every data frame on a CRC-armed link carries an 8-byte trailer: the
+# link-local send sequence number and a CRC-32 over payload-then-seq.
+# The receiver validates before any byte reaches the reduction, NACKs
+# the expected seq on mismatch, and the sender replays from its
+# retained copy (utils/ladder.py).
+
+_TRAILER = struct.Struct("<II")
+TRAILER_BYTES = _TRAILER.size
+
+
+class WireCorruptionError(ConnectionError):
+    """A data frame failed CRC validation (or the ladder exhausted its
+    retransmit/reconnect budget trying to heal a link).  Carries the
+    peer rank and hop phase like :class:`~ops.cpu_backend.HopTimeout`,
+    so the engine can feed the same gang-wide abort agreement."""
+
+    def __init__(self, peer: int, cause: str):
+        super().__init__(
+            f"data-plane link to rank {peer} is corrupt past the "
+            f"recovery ladder ({cause})")
+        self.peer = int(peer)
+        self.phase = "recv"
+        self.cause = cause
+
+
+def data_crc(payload, seq: int) -> int:
+    """CRC-32 (zlib polynomial) over the payload bytes then the packed
+    seq — covering the seq binds the checksum to the frame's position in
+    the stream, so a replayed-but-stale frame can never validate."""
+    crc = zlib.crc32(payload)
+    return zlib.crc32(struct.pack("<I", seq & 0xFFFFFFFF), crc)
+
+
+def pack_trailer(payload, seq: int) -> bytes:
+    return _TRAILER.pack(seq & 0xFFFFFFFF, data_crc(payload, seq))
+
+
+def split_trailer(frame: memoryview) -> Tuple[memoryview, int, int]:
+    """Split a trailered data frame into (payload_view, seq, crc); the
+    caller validates ``crc == data_crc(payload_view, seq)``."""
+    if len(frame) < TRAILER_BYTES:
+        raise ValueError("data frame shorter than its CRC trailer")
+    body = frame[:-TRAILER_BYTES]
+    seq, crc = _TRAILER.unpack(frame[-TRAILER_BYTES:])
+    return body, seq, crc
+
+
+def encode_nack(expected_seq: int) -> bytes:
+    return struct.pack("<I", expected_seq & 0xFFFFFFFF)
+
+
+def decode_nack(data: bytes) -> int:
+    return struct.unpack_from("<I", data, 0)[0]
+
+
+def encode_resume(rank: int, expected_seq: int, epoch: int = 0) -> bytes:
+    """Both RESUME (post-reconnect) and FAILOVER (shm->TCP demotion)
+    ride this payload: who is speaking, the next data seq they expect
+    to receive, and their membership epoch (stale-incarnation guard)."""
+    return struct.pack("<iII", rank, expected_seq & 0xFFFFFFFF, epoch)
+
+
+def decode_resume(data: bytes) -> Tuple[int, int, int]:
+    return struct.unpack_from("<iII", data, 0)
 
 
 # -- serving admission broadcast (docs/serving.md) ----------------------

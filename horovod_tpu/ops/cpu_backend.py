@@ -41,6 +41,12 @@ from typing import List, Optional
 import numpy as np
 
 from horovod_tpu.common import fault_injection as _fi
+# Raised out of a ladder link (HVD_WIRE_CRC=1) when every self-healing
+# rung is exhausted; propagates through the collectives untouched (it is
+# a ConnectionError, deliberately NOT mapped to HopTimeout — the peer is
+# provably misbehaving, not merely slow) and the engine escalates it
+# into the same gang-wide abort agreement as a hop deadline.
+from horovod_tpu.common.wire import WireCorruptionError  # noqa: F401
 from horovod_tpu.common.types import DataType, ReduceOp, Response
 from horovod_tpu.ops.fusion_buffer import FusionBuffer
 from horovod_tpu.telemetry import registry as _tmx

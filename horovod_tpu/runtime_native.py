@@ -54,6 +54,17 @@ class NativeEngine:
         from horovod_tpu import native
         from horovod_tpu.bootstrap import bootstrap_mesh
 
+        # The recovery ladder (CRC trailers, NACK retransmit; see
+        # csrc/wire.h) is a Python-engine data plane.  Refusing the knob
+        # before any rendezvous traffic keeps the failure loud: a native
+        # rank silently joining a CRC-armed gang would reduce peers'
+        # 8-byte trailers as payload.
+        from horovod_tpu.utils import env as _env_util
+
+        if _env_util.wire_crc():
+            raise RuntimeError(
+                "HVD_WIRE_CRC=1 is not supported by the native engine; "
+                "unset it or run the Python engine (HVD_TPU_CORE=py)")
         self._lib = native.load()
         self.rank = rank
         self.size = size

@@ -533,9 +533,21 @@ class PyEngine(_EngineBase):
     def _bootstrap(self, rdv_addr: str, rdv_port: int) -> None:
         from horovod_tpu.bootstrap import bootstrap_mesh
 
-        (self._data, self._ctrl_sock, self._ctrl_socks,
-         kv, kv_prefix) = bootstrap_mesh(
-            self.rank, self.size, rdv_addr, rdv_port, shm_capable=True)
+        # Recovery-ladder mode (HVD_WIRE_CRC=1, docs/fault_tolerance.md
+        # "recovery ladder"): keep the bootstrap listener open so a
+        # dropped data socket can be re-dialed mid-gang, and remember
+        # every peer's advertised address for the re-dial.
+        ladder_on = env_util.wire_crc()
+        self._reconnect_listener = None
+        if ladder_on:
+            (self._data, self._ctrl_sock, self._ctrl_socks,
+             kv, kv_prefix, mesh_peers, mesh_listener) = bootstrap_mesh(
+                self.rank, self.size, rdv_addr, rdv_port,
+                shm_capable=True, keep_listener=True)
+        else:
+            (self._data, self._ctrl_sock, self._ctrl_socks,
+             kv, kv_prefix) = bootstrap_mesh(
+                self.rank, self.size, rdv_addr, rdv_port, shm_capable=True)
 
         # Data-plane hot-path state (docs/performance.md): one transport
         # per peer, selected at mesh-build time (shm ring for same-host
@@ -549,14 +561,25 @@ class PyEngine(_EngineBase):
         from horovod_tpu.ops.fusion_buffer import FusionBuffer
         from horovod_tpu.utils import transport as tpt
 
-        self._transports = tpt.build_transports(
-            self.rank, self.size, self._data, kv, kv_prefix)
-        # TCP transports own the engine's PeerSenders; shm peers have no
-        # socket sender (their thread lives inside the transport), so the
-        # per-peer sender-thread count stays exactly one either way.
-        self._senders = {r: t.sender
-                         for r, t in self._transports.items()
-                         if t.kind == "tcp"}
+        if ladder_on:
+            from horovod_tpu.utils import ladder
+
+            self._transports, self._reconnect_listener = \
+                ladder.build_ladder_links(
+                    self.rank, self.size, self._data, kv, kv_prefix,
+                    mesh_peers, mesh_listener, epoch=self.epoch)
+            # Ladder links own their sender threads (no PeerSenders).
+            self._senders = {}
+        else:
+            self._transports = tpt.build_transports(
+                self.rank, self.size, self._data, kv, kv_prefix)
+            # TCP transports own the engine's PeerSenders; shm peers
+            # have no socket sender (their thread lives inside the
+            # transport), so the per-peer sender-thread count stays
+            # exactly one either way.
+            self._senders = {r: t.sender
+                             for r, t in self._transports.items()
+                             if t.kind == "tcp"}
         self._fusion_buf = FusionBuffer()
 
         # ctrl receiver threads
@@ -884,6 +907,14 @@ class PyEngine(_EngineBase):
         # the hvd-send-shm-* thread, and unmaps the segment (the /dev/shm
         # name was already unlinked at pairing time, so nothing can leak
         # even if this process dies before reaching here).
+        # Ladder mode: stop accepting reconnect re-dials before links
+        # close, so no freshly-routed socket lands on a dying link.
+        rl = getattr(self, "_reconnect_listener", None)
+        if rl is not None:
+            try:
+                rl.close()
+            except Exception:
+                pass
         transports = list(getattr(self, "_transports", {}).values())
         for t in transports:
             if t.kind != "tcp":
@@ -1979,6 +2010,18 @@ class PyEngine(_EngineBase):
                 # The always-on send-wait backstop tripped with the
                 # deadline knob off: surface it like any other
                 # data-plane failure (no abort agreement to run).
+                self.log.error("collective %s failed: %r", op_name, e)
+                status = Status.unknown_error(str(e))
+        except wire.WireCorruptionError as e:
+            # The recovery ladder exhausted every rung on a link
+            # (retransmit budget, reconnect window, failover) — the
+            # bottom rung is the exact PR-6 gang-wide abort/evict/replay
+            # a hop deadline takes (docs/fault_tolerance.md).
+            results = [None] * len(entries)
+            if deadline_on:
+                self._in_collective_since = 0.0
+                status = self._collective_abort(resp, entries, e)
+            else:
                 self.log.error("collective %s failed: %r", op_name, e)
                 status = Status.unknown_error(str(e))
         except Exception as e:

@@ -117,6 +117,17 @@ KNOWN_METRICS: Dict[str, dict] = {
     "hvd_collective_abort_seconds": _hist(
         "Latency from a rank's local hop timeout to the applied "
         "gang-wide abort verdict.", *_SECONDS),
+    "hvd_hop_retries_total": _counter(
+        "Data frames retransmitted by the recovery ladder, by cause "
+        "(corrupt = CRC mismatch NACK, reset = replay after a peer "
+        "reset/reconnect, failover = replay after an shm->TCP "
+        "demotion).", labels=("cause",)),
+    "hvd_peer_reconnects_total": _counter(
+        "Dropped data sockets re-dialed and resumed in place by the "
+        "recovery ladder (no eviction)."),
+    "hvd_transport_failovers_total": _counter(
+        "Peer pairs demoted from a faulted shm ring to TCP in place by "
+        "the recovery ladder."),
     "hvd_kv_retries_total": _counter(
         "Rendezvous KV client request retries."),
     "hvd_elastic_epoch": _gauge(

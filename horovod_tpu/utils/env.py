@@ -42,6 +42,27 @@ SOCK_BUF_BYTES = "HVD_SOCK_BUF_BYTES"
 SHM_DISABLE = "HVD_SHM_DISABLE"
 SHM_SLOT_BYTES = "HVD_SHM_SLOT_BYTES"
 SHM_SLOTS = "HVD_SHM_SLOTS"
+# Shm seqlock wait policy (docs/performance.md "Transport selection").
+# SHM_SPIN is the hot-spin iteration count before a wait starts
+# yielding; SHM_SLEEP_US is the escalating-microsleep ceiling in
+# microseconds.  Defaults adapt to the host's core count (spinning is
+# only profitable when the peer can run WHILE we spin).
+SHM_SPIN = "HVD_SHM_SPIN"
+SHM_SLEEP_US = "HVD_SHM_SLEEP_US"
+# Data-plane recovery ladder (docs/fault_tolerance.md "recovery
+# ladder").  WIRE_CRC=1 arms the whole ladder: every data frame gains a
+# CRC-32 + sequence trailer (mirrored in csrc/wire.h), a corrupt frame
+# is NACKed and retransmitted from the sender's retained copy (at most
+# HOP_RETRIES times per link before the link is declared corrupt), a
+# dropped data socket is re-dialed for up to RECONNECT_TIMEOUT_S with
+# the PR-1 backoff+jitter, and a faulted shm ring demotes its peer pair
+# to TCP in place.  Off (default) = byte-identical seed framing and
+# zero new threads.  LADDER_RETAIN bounds the per-link replay buffer
+# (frames).
+WIRE_CRC = "HVD_WIRE_CRC"
+HOP_RETRIES = "HVD_HOP_RETRIES"
+RECONNECT_TIMEOUT_S = "HVD_RECONNECT_TIMEOUT_S"
+LADDER_RETAIN = "HVD_LADDER_RETAIN"
 # Liveness / fault tolerance (PyEngine; 0 = heartbeats disabled).
 # HOROVOD_HEARTBEAT_TIMEOUT is accepted as an alias of the HVD_ name.
 HEARTBEAT_TIMEOUT = "HVD_HEARTBEAT_TIMEOUT"
@@ -165,6 +186,48 @@ def shm_slots() -> int:
     """Slots per directed shm ring; floor 2 (writer needs one slot in
     flight while the reader drains another)."""
     return max(2, get_int(SHM_SLOTS, 16))
+
+
+def shm_spin() -> int:
+    """Hot-spin iterations before a shm wait starts yielding.  Spinning
+    only pays when a spare core can run the peer meanwhile, so the
+    default is 64 on multi-core hosts and 0 on a single core."""
+    cpus = os.cpu_count() or 1
+    return max(0, get_int(SHM_SPIN, 64 if cpus > 1 else 0))
+
+
+def shm_sleep_us() -> int:
+    """Escalating-microsleep ceiling for shm waits, in microseconds
+    (floor 10).  Default 200 us: long enough to stop a yield storm from
+    starving the producer, short enough that a ring hop's wake-up
+    latency stays well under the kernel's socket wake path (the old
+    single-core 1 ms ceiling is what lost BENCH_r08's shm-vs-TCP
+    shoot-out)."""
+    return max(10, get_int(SHM_SLEEP_US, 200))
+
+
+def wire_crc() -> bool:
+    """True when the recovery ladder (CRC trailers, NACK retransmit,
+    reconnect, shm->TCP failover) is armed.  Default off = the seed's
+    exact framing and thread census."""
+    return get_bool(WIRE_CRC, False)
+
+
+def hop_retries() -> int:
+    """Per-link NACK-retransmit budget before the ladder declares the
+    link corrupt and escalates; floor 0 (= first corruption escalates)."""
+    return max(0, get_int(HOP_RETRIES, 8))
+
+
+def reconnect_timeout_s() -> float:
+    """Re-dial/re-accept budget for one dropped data socket; past it
+    the ladder escalates to the gang abort."""
+    return max(0.1, get_float(RECONNECT_TIMEOUT_S, 20.0))
+
+
+def ladder_retain() -> int:
+    """Retained sent frames per link (the replay buffer); floor 2."""
+    return max(2, get_int(LADDER_RETAIN, 32))
 
 
 def collective_timeout_s() -> float:

@@ -50,6 +50,13 @@ TAG_ABORT_VERDICT = 9   # coordinator -> workers: agreed wedged ranks
 # delta to every rank so the whole gang steps the same jit-ed decode
 # function.  Payload codec: common/wire.py; protocol: docs/serving.md.
 TAG_SERVE = 10          # coordinator -> workers: serve-step batch delta
+# Recovery-ladder control frames (Python engine only, HVD_WIRE_CRC=1;
+# utils/ladder.py, docs/fault_tolerance.md "recovery ladder").  These
+# ride the data links themselves, never the ctrl star: csrc/wire.h
+# reserves the values so the native engine can refuse them cleanly.
+TAG_NACK = 11           # receiver -> sender: retransmit from seq
+TAG_RESUME = 12         # both ways on a reconnected socket: resume point
+TAG_FAILOVER = 13       # both ways on the mesh socket: shm->TCP demotion
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:

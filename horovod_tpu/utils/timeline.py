@@ -47,6 +47,16 @@ CKPT_VERIFY_FAIL = "CKPT_VERIFY_FAIL"
 # rank(s).
 COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
 
+# Recovery-ladder records (utils/ladder.py; docs/fault_tolerance.md
+# "recovery ladder").  HOP_RETRY = a data frame was retransmitted on one
+# link (args name the peer and cause: corrupt/reset/failover);
+# TRANSPORT_FAILOVER = a peer pair was demoted from shm to TCP in place.
+# Both are instants on the rank that healed — a soak run with zero
+# ELASTIC_REFORM records but HOP_RETRY records present is the ladder
+# working as designed.
+HOP_RETRY = "HOP_RETRY"
+TRANSPORT_FAILOVER = "TRANSPORT_FAILOVER"
+
 # Telemetry records (horovod_tpu.telemetry; docs/metrics.md).
 STRAGGLER = "STRAGGLER"
 

@@ -187,16 +187,20 @@ _SLOT_HDR = 16
 
 _SHM_PREFIX = "hvd-shm-"
 
-# Wait-loop shape.  Spinning is only profitable when the peer can make
-# progress WHILE we spin — i.e. there is a spare core for it.  On an
-# oversubscribed host (1 core, N ranks) every spin iteration and every
-# sub-ms wakeup steals the quantum the writer needs, so skip the hot
-# spin, yield almost immediately, and let the sleep escalate to a
-# scheduler-friendly 1 ms instead of the 200 us latency-optimized cap.
+# Wait-loop shape, env-tunable (HVD_SHM_SPIN / HVD_SHM_SLEEP_US;
+# docs/performance.md "Transport selection").  Spinning is only
+# profitable when the peer can make progress WHILE we spin — i.e. there
+# is a spare core for it — so the spin default drops to 0 on a single
+# core.  The escalating microsleep is capped at HVD_SHM_SLEEP_US on
+# every host: the old single-core 1 ms ceiling meant ~0.5 ms average
+# wake-up latency per slot while the TCP path got kernel-event wakeups,
+# which is how shm lost its own shoot-out in BENCH_r08.  On one core the
+# yield phase is what hands the quantum to the producer; the sleep only
+# exists so a yield storm cannot starve it.
 _CPUS = os.cpu_count() or 1
-_SPIN_HOT = 64 if _CPUS > 1 else 0
-_SPIN_YIELD = 512 if _CPUS > 1 else 16
-_READ_SLEEP_CAP = 2e-4 if _CPUS > 1 else 1e-3
+_SPIN_HOT = env_util.shm_spin()
+_SPIN_YIELD = _SPIN_HOT + (512 if _CPUS > 1 else 256)
+_READ_SLEEP_CAP = env_util.shm_sleep_us() * 1e-6
 
 
 def _slot_stride(slot_bytes: int) -> int:
@@ -337,7 +341,8 @@ class _RingWriter:
                 continue
             if stopped():
                 raise ConnectionError("shm transport closed")
-            time.sleep(0 if n < _SPIN_YIELD else min(0.001, 1e-6 * n))
+            time.sleep(0 if n < _SPIN_YIELD else
+                       min(_READ_SLEEP_CAP, 1e-6 * n))
 
     def _publish(self, w: int, nbytes: int) -> None:
         base = self._slot_base(w)
@@ -614,7 +619,8 @@ _CREATE_FAILED = "none"
 
 def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
                      kv, prefix: str,
-                     timeout: Optional[float] = None
+                     timeout: Optional[float] = None,
+                     tcp_factory=None, shm_factory=None
                      ) -> Dict[int, Transport]:
     """One :class:`Transport` per mesh peer, selected at mesh-build time.
 
@@ -627,9 +633,20 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
     Peers are processed in ascending rank order on every rank; the
     globally smallest unfinished pair can always complete, so the
     ack waits cannot deadlock.
+
+    ``tcp_factory(sock, peer)`` / ``shm_factory(sock, seg, lower, peer)``
+    override what gets built on the selected medium without duplicating
+    the pairing protocol — utils/ladder.py uses them to wrap every pair
+    in a self-healing :class:`LadderLink` when ``HVD_WIRE_CRC=1``.
     """
     if timeout is None:
         timeout = env_util.get_float("HVD_START_TIMEOUT", 120.0)
+    if tcp_factory is None:
+        def tcp_factory(sock, peer):
+            return TcpTransport(sock, peer=peer)
+    if shm_factory is None:
+        def shm_factory(sock, seg, lower, peer):
+            return ShmRingTransport(seg, lower=lower, peer=peer)
     transports: Dict[int, Transport] = {}
     mine = host_record_value(rank, shm_capable=True)
     want_shm = shm_enabled() and "|" in mine
@@ -640,7 +657,7 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
         if isinstance(peer_fp, bytes):
             peer_fp = peer_fp.decode()
         if not want_shm or peer_fp != mine:
-            transports[r] = TcpTransport(sock, peer=r)
+            transports[r] = tcp_factory(sock, r)
             continue
         a, b = (rank, r) if rank < r else (r, rank)
         name_key = f"{prefix}shm/{a}_{b}"
@@ -653,7 +670,7 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
             except Exception:
                 kv.put(name_key, _CREATE_FAILED)
             if seg is None:
-                transports[r] = TcpTransport(sock, peer=r)
+                transports[r] = tcp_factory(sock, r)
                 continue
             try:
                 ack = kv.wait_get(ack_key, timeout=timeout)
@@ -666,10 +683,10 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
             if isinstance(ack, bytes):
                 ack = ack.decode()
             if ack == "ok":
-                transports[r] = ShmRingTransport(seg, lower=True, peer=r)
+                transports[r] = shm_factory(sock, seg, True, r)
             else:
                 seg.close()
-                transports[r] = TcpTransport(sock, peer=r)
+                transports[r] = tcp_factory(sock, r)
         else:
             name = kv.wait_get(name_key, timeout=timeout)
             if isinstance(name, bytes):
@@ -683,10 +700,10 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
                     seg = None
             if seg is None:
                 kv.put(ack_key, "fail")
-                transports[r] = TcpTransport(sock, peer=r)
+                transports[r] = tcp_factory(sock, r)
             else:
                 kv.put(ack_key, "ok")
-                transports[r] = ShmRingTransport(seg, lower=False, peer=r)
+                transports[r] = shm_factory(sock, seg, False, r)
     return transports
 
 

@@ -1,0 +1,202 @@
+"""Recovery-ladder gang scenarios for tests/test_ladder.py.
+
+Two scenarios, both over a 3-rank gang running the full ladder
+(``HVD_WIRE_CRC=1``, docs/fault_tolerance.md "recovery ladder"):
+
+``soak``
+    Randomized chaos soak.  The driver installs the seedable
+    ``HOROVOD_FAULT_PLAN=random:<seed>:<rate>`` schedule, which sweeps
+    every transient fault the ladder must self-heal — ``sock.corrupt``
+    (rung 1: NACK + retransmit), ``sock.reset`` (rung 2: reconnect +
+    resume) and ``shm.lost`` (rung 3: in-place failover to TCP).  Rank 2
+    runs with ``HVD_SHM_DISABLE=1`` so the gang exercises BOTH data
+    transports at once: pair (0,1) starts on shm rings, pairs (0,2) and
+    (1,2) on TCP.  Every fused step's result is asserted bit-identical
+    to the fault-free oracle *in-process* (the inputs are small integers,
+    exact in float32, so the ring's association order cannot perturb the
+    sum).  The collective deadline is ARMED — the scenario proves the
+    ladder heals faults well before the PR-6 abort machinery would fire.
+
+``exhaust``
+    Ladder exhaustion.  The victim rank corrupts every data frame it
+    sends from step 1 on, forever; its downstream neighbor burns through
+    ``HVD_HOP_RETRIES`` NACK rounds and declares the link corrupt
+    (``WireCorruptionError``), which escalates into the EXACT PR-6
+    abort/evict/replay path: gang-wide agreement names the victim, the
+    elastic wrapper re-forms without it, and the aborted fused batch is
+    replayed bit-identically from the survivors' retained inputs.  The
+    victim runs with a much longer collective deadline so it never
+    self-reports — the verdict must come from the corruption evidence,
+    not from the victim timing out on its own.
+
+Markers (``flush=True`` so the driver parses them even on abrupt death):
+
+* ``MODES {"1": "shm", ...}`` — initial per-peer link transport, proving
+  the mixed shm/TCP topology actually paired (soak only).
+* ``STEP <i> <v>``  — element 0 of the step's first reduced tensor.
+* ``CTE ranks=<json> tensor=<name>`` — the typed abort (exhaust only).
+* ``REPLAY <name> <hex>`` — a replayed tensor's exact result bytes.
+* ``SNAP <json>`` — the rank's ladder counters after training (soak).
+* ``DONE <rank>`` — scenario complete.
+
+Exit codes: 0 scenario complete, 3 scenario assertion failed; the
+exhaust victim exits nonzero on its own when the gang evicts it.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SOAK_STEPS = 12
+EXHAUST_STEPS = 4
+VICTIM_STEP = 1
+N = 8
+NAMES = ("grad.a", "grad.b", "grad.c")
+
+
+def grad(rank, step, j):
+    """Deterministic per-(rank, step, tensor) input.  Integer-valued and
+    small, so float32 ring reductions are exact in ANY association order
+    and bit-identity against the oracle is meaningful under healing."""
+    return (np.arange(N, dtype=np.float32) * (j + 1)
+            + 10.0 * rank + 100.0 * step).astype(np.float32)
+
+
+def _ladder_links(hvd):
+    from horovod_tpu import basics
+
+    rt = basics._runtime
+    links = dict(rt._transports)
+    assert links, "no data-plane links built"
+    kinds = {t.kind for t in links.values()}
+    assert kinds == {"ladder"}, \
+        f"HVD_WIRE_CRC=1 must build ladder links, got {kinds}"
+    return links
+
+
+def scenario_soak(hvd):
+    from horovod_tpu.common import fault_injection as fi
+
+    rank = hvd.rank()
+    links = _ladder_links(hvd)
+    modes = {str(p): t._mode for p, t in sorted(links.items())}
+    print("MODES " + json.dumps(modes), flush=True)
+
+    from horovod_tpu.ops import eager
+
+    state = hvd.elastic.ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        assert not hvd.elastic.last_replay_results(), \
+            "soak must never abort a batch, yet a replay was retained"
+        while state.step < SOAK_STEPS:
+            size = hvd.size()
+            assert size == 3, f"gang re-formed to {size} ranks"
+            handles = [eager.allreduce_async(
+                grad(rank, state.step, j), op=hvd.Sum,
+                name=f"{nm}.s{state.step}")
+                for j, nm in enumerate(NAMES)]
+            outs = [eager.synchronize(h) for h in handles]
+            for j, out in enumerate(outs):
+                oracle = grad(0, state.step, j)
+                for r in range(1, size):
+                    oracle = oracle + grad(r, state.step, j)
+                got = np.asarray(out, dtype=np.float32)
+                assert got.tobytes() == oracle.tobytes(), \
+                    (state.step, j, got, oracle)
+            print(f"STEP {state.step} "
+                  f"{float(np.asarray(outs[0])[0])}", flush=True)
+            state.step += 1
+            state.commit()
+
+    train(state)
+    # Stop injecting before shutdown: the scenario grades the ladder
+    # under TRAINING chaos; a reset landing on the final drain would
+    # only make teardown slow, not prove anything further.
+    fi.clear()
+    snap = hvd.metrics_snapshot()
+    ladder = {k: v for k, v in snap.get("counters", {}).items()
+              if "hop_retries" in k or "reconnect" in k
+              or "failover" in k}
+    print(f"SNAP {json.dumps(ladder)}", flush=True)
+    print(f"DONE {rank}", flush=True)
+
+
+def scenario_exhaust(hvd):
+    from horovod_tpu.common import fault_injection as fi
+    from horovod_tpu.common.types import CollectiveTimeoutError
+    from horovod_tpu.ops import eager
+
+    victim = os.environ.get("LADDER_VICTIM") == "1"
+    rank = hvd.rank()
+    _ladder_links(hvd)
+
+    state = hvd.elastic.ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        replayed = hvd.elastic.last_replay_results()
+        if replayed:
+            for nm in sorted(replayed):
+                print(f"REPLAY {nm} "
+                      f"{np.asarray(replayed[nm]).tobytes().hex()}",
+                      flush=True)
+        while state.step < EXHAUST_STEPS:
+            if victim and state.step == VICTIM_STEP:
+                # Corrupt EVERY data frame this rank sends, forever: the
+                # downstream peer's NACK budget is finite, so rung 1 is
+                # guaranteed to exhaust into WireCorruptionError.
+                fi.configure({"faults": [
+                    {"site": "sock.corrupt", "kind": "corrupt"}]})
+            try:
+                handles = [eager.allreduce_async(
+                    grad(rank, state.step, j), op=hvd.Sum,
+                    name=f"{nm}.s{state.step}")
+                    for j, nm in enumerate(NAMES)]
+                outs = [eager.synchronize(h) for h in handles]
+            except CollectiveTimeoutError as e:
+                print(f"CTE ranks={json.dumps(e.ranks)} "
+                      f"tensor={e.tensor_name}", flush=True)
+                raise  # the elastic wrapper owns evict-and-replay
+            print(f"STEP {state.step} "
+                  f"{float(np.asarray(outs[0])[0])}", flush=True)
+            state.step += 1
+            state.commit()
+
+    train(state)
+    print(f"DONE {rank}", flush=True)
+
+
+SCENARIOS = {
+    "soak": scenario_soak,
+    "exhaust": scenario_exhaust,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from horovod_tpu import basics
+
+    expect = os.environ.get("HVD_EXPECT_ENGINE")
+    if expect:
+        assert type(basics._runtime).__name__ == expect
+
+    try:
+        SCENARIOS[scenario](hvd)
+    except AssertionError:
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(3)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -28,10 +28,24 @@ assert jax.device_count() == size, (
 # A real cross-process collective through the global view.
 from jax.experimental import multihost_utils  # noqa: E402
 
-gathered = multihost_utils.process_allgather(
-    np.array([rank + 1.0], np.float32))
+try:
+    gathered = multihost_utils.process_allgather(
+        np.array([rank + 1.0], np.float32))
+except Exception as e:  # jaxlib.xla_extension.XlaRuntimeError
+    if "Multiprocess computations aren't implemented" in str(e):
+        # This jaxlib's CPU backend cannot run cross-process programs;
+        # the global-view wiring above already succeeded (process_count
+        # and device_count span the gang), only the collective itself is
+        # unimplemented.  Exit 42 so the driver can capability-skip.
+        print(f"rank {rank}: CPU backend lacks multiprocess "
+              "computations", flush=True)
+        sys.exit(42)
+    raise
+# Single-process allgather returns the input unstacked; reshape to the
+# (size, 1) stacked view so one assertion covers both regimes.
 expect = np.arange(1, size + 1, dtype=np.float32)[:, None]
-np.testing.assert_allclose(np.asarray(gathered), expect)
+np.testing.assert_allclose(
+    np.asarray(gathered).reshape(expect.shape), expect)
 
 # The eager engine still works alongside (two regimes, one process).
 out = hvd.allreduce(np.ones(4, np.float32), name="mh.check", op=hvd.Sum)

@@ -1,0 +1,175 @@
+"""Chaos-coverage lint as a test, plus the tests that close its gaps.
+
+tools/check_fault_coverage.py enforces the last leg of the chaos
+contract: every site in ``fault_injection.KNOWN_SITES`` must be
+*exercised* by at least one test (word-boundary appearance under
+``tests/`` — a fault plan naming it, or a direct drive of the hook).
+test_fault_sites.py already pins registry<->code<->docs agreement; this
+file pins registry<->suite agreement, and hosts the targeted exercises
+for the handful of sites no scenario test happened to pull: the KV
+client's delete retry, the persistent sender's half-open surfacing, and
+the bootstrap/cycle/control/shm-pairing sites a plain gang walks through
+under harmless delay faults.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import check_fault_coverage  # noqa: E402
+
+from horovod_tpu.common import fault_injection as fi  # noqa: E402
+from horovod_tpu.runner.http_client import KVClient  # noqa: E402
+from horovod_tpu.runner.http_server import RendezvousServer  # noqa: E402
+from horovod_tpu.utils import socketutil as su  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "chaos_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# the lint itself
+# ---------------------------------------------------------------------------
+
+
+def test_every_registered_site_is_exercised():
+    missing = check_fault_coverage.unexercised_sites()
+    assert not missing, (
+        f"registered fault sites never exercised by any test: {missing} "
+        "— add a test that drives each site "
+        "(see tools/check_fault_coverage.py)")
+
+
+def test_coverage_scan_on_synthetic_tree(tmp_path):
+    t = tmp_path / "tests"
+    t.mkdir()
+    # One site in a plan literal, one in prose; substrings and
+    # dotted extensions must NOT count as coverage.
+    (t / "t_a.py").write_text(
+        'PLAN = {"faults": [{"site": "sock.send", "kind": "error"}]}\n'
+        '# prose mention of kv.mirror is coverage too\n'
+        '# neither kv.get.retry nor grad.nonfinite_extra may count\n'
+        '# as covering their dotted/underscored prefixes\n')
+    hit = check_fault_coverage.exercised_sites(t)
+    assert set(hit) == {"sock.send", "kv.mirror"}, hit
+    missing = check_fault_coverage.unexercised_sites(t)
+    assert "kv.get" in missing and "grad.nonfinite" in missing
+    assert "sock.send" not in missing
+
+
+# ---------------------------------------------------------------------------
+# targeted exercises for the sites no scenario test pulls
+# ---------------------------------------------------------------------------
+
+
+def test_kv_delete_retries_through_injected_fault():
+    """``kv.delete``: one injected error is absorbed by the client's
+    retry loop and the key still comes off the server."""
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    try:
+        c = KVClient("127.0.0.1", port)
+        c.put("cov/x", "1")
+        fi.configure({"faults": [
+            {"site": "kv.delete", "kind": "error", "times": 1}]})
+        c.delete("cov/x")
+        fi.clear()
+        assert c.get("cov/x") is None
+    finally:
+        server.stop()
+
+
+def test_halfopen_sender_surfaces_at_wait():
+    """``sock.halfopen``: a blackholed outbound path stalls the sender
+    thread, then surfaces as ``ConnectionError`` at ``wait()`` — the hop
+    loop's signal to run recovery instead of hanging."""
+    a, b = socket.socketpair()
+    sender = su.PeerSender(a, name="cov-halfopen")
+    try:
+        fi.configure({"faults": [
+            {"site": "sock.halfopen", "kind": "halfopen",
+             "stall_s": 0.05}]})
+        ticket = sender.send(b"payload")
+        with pytest.raises(ConnectionError):
+            sender.wait(ticket, timeout=10.0)
+    finally:
+        fi.clear()
+        sender.close(timeout=5.0)
+        a.close()
+        b.close()
+
+
+def test_gang_walks_bootstrap_cycle_ctrl_and_shm_sites():
+    """A 2-rank same-host gang under harmless delay faults drives the
+    remaining hooks end-to-end: ``bootstrap.start`` and
+    ``bootstrap.accept`` during mesh formation, ``shm.attach`` while the
+    local pair maps its rings, then ``engine.cycle`` and
+    ``ctrl.coord.send`` on the background loop — the gang must still
+    bootstrap and reduce correctly with every one of them firing."""
+    plan = {"faults": [
+        {"site": "bootstrap.start", "kind": "delay", "delay_s": 0.01},
+        {"site": "bootstrap.accept", "kind": "delay", "delay_s": 0.01},
+        {"site": "shm.attach", "kind": "delay", "delay_s": 0.01},
+        {"site": "engine.cycle", "kind": "delay", "delay_s": 0.005,
+         "times": 10},
+        {"site": "ctrl.coord.send", "kind": "delay", "delay_s": 0.005,
+         "times": 10},
+    ]}
+    np_ = 2
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (REPO + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_CROSS_RANK": "0",
+                "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_CORE": "py",
+                "HVD_EXPECT_ENGINE": "PyEngine",
+                fi.ENV_VAR: json.dumps(plan),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, "bootstrap_allreduce"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.monotonic() + 120.0
+        for rank, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = p.communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(f"rank {rank} hung under delays")
+            assert p.returncode == 0, (rank, out.decode(), err.decode())
+            assert f"BOOT_OK {rank}" in out.decode(), out.decode()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

@@ -1,0 +1,643 @@
+"""Self-healing data-plane recovery ladder (docs/fault_tolerance.md,
+"recovery ladder"; ``HVD_WIRE_CRC=1``).
+
+Layered like the subsystem itself:
+
+* wire codecs — CRC32 data trailer, NACK / RESUME roundtrips, the typed
+  ``WireCorruptionError`` surface.
+* fault-plan plumbing — the seedable ``random:<seed>:<rate>`` chaos
+  schedule: deterministic under a seed, sweeping exactly the transient
+  fault kinds the ladder heals.
+* knob-off pins — with ``HVD_WIRE_CRC`` unset the engine builds the
+  seed transports and puts byte-identical seed frames on the wire (no
+  trailer, no new tags).
+* in-process link pairs — every rung in isolation over real loopback
+  sockets / shm rings: retransmit, reconnect, failover, exhaustion.
+* the acceptance gangs — a randomized 3-rank chaos soak over MIXED
+  shm+TCP links that must stay bit-identical to the fault-free oracle
+  with zero evictions, and a ladder-exhaustion gang proving the bottom
+  rung escalates into the EXACT PR-6 abort/evict/replay machinery.
+"""
+
+import json
+import os
+import re
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.common import wire
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.telemetry import registry as tmx
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import ladder
+from horovod_tpu.utils import socketutil as su
+from horovod_tpu.utils import transport as tpt
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "ladder_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+@pytest.fixture
+def metrics():
+    """Arm the process-local registry and return a delta-reader for the
+    ladder counters (counters are process-global and survive configure,
+    so assertions must be deltas, not absolutes)."""
+    tmx.configure(True)
+
+    def snap():
+        return {k: v for k, v in tmx.snapshot()["counters"].items()
+                if "hop_retries" in k or "reconnect" in k
+                or "failover" in k}
+
+    base = snap()
+    yield lambda: {k: v - base.get(k, 0.0) for k, v in snap().items()
+                   if v - base.get(k, 0.0) > 0}
+    tmx.configure(False)
+
+
+# ---------------------------------------------------------------------------
+# wire codecs
+# ---------------------------------------------------------------------------
+
+
+def test_data_trailer_roundtrip():
+    body = b"\x01\x02\x03\x04payload"
+    tr = wire.pack_trailer(body, 7)
+    assert len(tr) == wire.TRAILER_BYTES
+    view, seq, crc = wire.split_trailer(body + tr)
+    assert bytes(view) == body
+    assert seq == 7
+    assert crc == wire.data_crc(body, 7)
+
+
+def test_data_crc_covers_seq():
+    # The CRC must bind the sequence number, not just the payload — a
+    # replayed frame with a re-stamped seq may not pass validation.
+    body = b"same bytes"
+    assert wire.data_crc(body, 1) != wire.data_crc(body, 2)
+
+
+def test_split_trailer_detects_flipped_bit():
+    body = b"x" * 64
+    framed = bytearray(body + wire.pack_trailer(body, 3))
+    framed[10] ^= 0x01
+    view, seq, crc = wire.split_trailer(bytes(framed))
+    assert crc != wire.data_crc(bytes(view), seq)
+
+
+def test_nack_and_resume_roundtrip():
+    assert wire.decode_nack(wire.encode_nack(41)) == 41
+    assert wire.decode_resume(wire.encode_resume(2, 99, epoch=5)) == \
+        (2, 99, 5)
+
+
+def test_wire_corruption_error_surface():
+    e = wire.WireCorruptionError(3, "corrupt")
+    assert isinstance(e, ConnectionError)  # existing handling engages
+    assert e.peer == 3 and e.phase == "recv" and e.cause == "corrupt"
+    assert "rank 3" in str(e) and "recovery ladder" in str(e)
+
+
+def test_ladder_tags_reserved():
+    # The control tags ride the data links; they must stay clear of the
+    # seed tag space and of each other (csrc/wire.h mirrors the values).
+    tags = {su.TAG_NACK, su.TAG_RESUME, su.TAG_FAILOVER}
+    assert tags == {11, 12, 13}
+
+
+# ---------------------------------------------------------------------------
+# fault-plan plumbing: the seedable random chaos schedule
+# ---------------------------------------------------------------------------
+
+
+def test_random_schedule_sweeps_ladder_faults():
+    plan = fi.random_schedule(7, 0.25)
+    assert plan["seed"] == 7
+    sites = {f["site"]: f for f in plan["faults"]}
+    assert set(sites) == {"sock.corrupt", "sock.reset", "shm.lost"}
+    assert sites["sock.corrupt"]["kind"] == "corrupt"
+    assert all(f["prob"] == 0.25 for f in plan["faults"])
+
+
+def test_random_schedule_env_shorthand(monkeypatch):
+    monkeypatch.setenv(fi.ENV_VAR, "random:11:0.5")
+    fi._load_from_env()
+    assert fi.active()
+    fi.clear()
+
+
+def test_random_schedule_is_deterministic_per_seed():
+    def outcomes(seed):
+        fi.configure(fi.random_schedule(seed, 0.5))
+        seq = []
+        for _ in range(64):
+            try:
+                fi.fire("sock.reset")
+                seq.append(0)
+            except fi.InjectedFault:
+                seq.append(1)
+        fi.clear()
+        return seq
+
+    a, b, c = outcomes(3), outcomes(3), outcomes(4)
+    assert a == b          # same seed -> same chaos, exactly
+    assert a != c          # a different seed is a different soak
+    assert 1 in a and 0 in a
+
+
+def test_random_schedule_rate_bounds():
+    fi.configure(fi.random_schedule(1, 0.0))
+    for _ in range(32):
+        fi.fire("sock.reset")          # rate 0: never fires
+        assert not fi.should_corrupt("sock.corrupt")
+    fi.clear()
+    fi.configure(fi.random_schedule(1, 1.0))
+    assert fi.should_corrupt("sock.corrupt")  # rate 1: always
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("sock.reset")
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# knob-off pins: HVD_WIRE_CRC unset is byte-identical seed behavior
+# ---------------------------------------------------------------------------
+
+
+def test_wire_crc_knob_defaults_off(monkeypatch):
+    monkeypatch.delenv(env_util.WIRE_CRC, raising=False)
+    assert env_util.wire_crc() is False
+    monkeypatch.setenv(env_util.WIRE_CRC, "1")
+    assert env_util.wire_crc() is True
+    # Companion knobs have sane defaults without the ladder armed.
+    monkeypatch.delenv(env_util.HOP_RETRIES, raising=False)
+    monkeypatch.delenv(env_util.LADDER_RETAIN, raising=False)
+    assert env_util.hop_retries() == 8
+    assert env_util.ladder_retain() >= 2
+    assert env_util.reconnect_timeout_s() > 0
+
+
+def test_native_engine_rejects_wire_crc(monkeypatch):
+    """A native rank must refuse to join a CRC-armed gang (csrc/wire.h
+    contract): its C++ data plane would reduce peers' 8-byte trailers as
+    payload. The guard fires before native.load() and before any
+    rendezvous traffic, so this pins the behavior toolchain-free."""
+    from horovod_tpu.runtime_native import NativeEngine
+
+    monkeypatch.setenv(env_util.WIRE_CRC, "1")
+    with pytest.raises(RuntimeError, match="HVD_TPU_CORE=py"):
+        NativeEngine(0, 1, 0, 1, 0, 1, "127.0.0.1", 1)
+
+
+def test_knob_off_builds_seed_transports():
+    a, b = socket.socketpair()
+    t0, t1 = tpt.TcpTransport(a, peer=1), tpt.TcpTransport(b, peer=0)
+    try:
+        assert t0.kind == "tcp" and t1.kind == "tcp"
+        tag, got = t1.recv_frame(t0.wait(t0.send(b"pp"), timeout=5)
+                                 or time.monotonic() + 5)
+        assert (tag, got) == (su.TAG_DATA, b"pp")
+    finally:
+        t0.close()
+        t1.close()
+
+
+def test_knob_off_wire_bytes_are_seed_frames():
+    """The frames a seed transport emits carry NO trailer — the ladder
+    framing only exists behind HVD_WIRE_CRC=1 (a mixed gang would desync
+    otherwise)."""
+    a, b = socket.socketpair()
+    t = tpt.TcpTransport(a, peer=1)
+    try:
+        payload = b"q" * 100
+        t.wait(t.send(payload), timeout=5)
+        raw = su.recv_exact(b, su.HEADER.size + len(payload))
+        assert raw == su.HEADER.pack(su.TAG_DATA, len(payload)) + payload
+        # ...and nothing more follows on the wire.
+        b.setblocking(False)
+        with pytest.raises(BlockingIOError):
+            b.recv(1)
+    finally:
+        b.setblocking(True)
+        t.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process link pairs: each rung in isolation
+# ---------------------------------------------------------------------------
+
+
+def _xfer(l0, l1, n=8, size=1 << 13, seed=0):
+    """Bidirectional transfer of n frames each way, verified exactly."""
+    rng = np.random.default_rng(seed)
+    payloads = [rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+                for _ in range(n)]
+    errs = []
+
+    def tx(src, who):
+        try:
+            tickets = [src.send(p) for p in payloads]
+            for t in tickets:
+                src.wait(t, timeout=30)
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            errs.append((who, "send", repr(e)))
+
+    def rx(link, who):
+        try:
+            deadline = time.monotonic() + 30
+            for i, p in enumerate(payloads):
+                tag, got = link.recv_frame(deadline)
+                assert tag == su.TAG_DATA
+                assert got == p, f"{who} frame {i} corrupted through"
+        except Exception as e:  # noqa: BLE001
+            errs.append((who, "recv", repr(e)))
+
+    ths = [threading.Thread(target=tx, args=(l0, "l0")),
+           threading.Thread(target=tx, args=(l1, "l1")),
+           threading.Thread(target=rx, args=(l0, "l0")),
+           threading.Thread(target=rx, args=(l1, "l1"))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    assert not errs, errs
+
+
+def _pair(shm=False):
+    return ladder.make_ladder_pair(shm=shm)
+
+
+def _close(l0, l1, rl):
+    l0.close()
+    l1.close()
+    rl.close()
+
+
+def test_ladder_clean_tcp_transfer(metrics):
+    l0, l1, rl = _pair()
+    try:
+        _xfer(l0, l1)
+    finally:
+        _close(l0, l1, rl)
+    assert metrics() == {}  # a healthy link burns zero ladder budget
+
+
+def test_ladder_clean_shm_transfer(metrics):
+    l0, l1, rl = _pair(shm=True)
+    try:
+        assert l0._mode == "shm" and l1._mode == "shm"
+        _xfer(l0, l1)
+        assert l0._mode == "shm"  # no silent demotion on a healthy ring
+    finally:
+        _close(l0, l1, rl)
+    assert metrics() == {}
+
+
+def test_rung1_corruption_nack_retransmit(metrics):
+    """A flipped wire byte NACKs back to the sender, which replays from
+    retained copies — the receiver sees clean bytes, the counter names
+    the cause."""
+    fi.configure({"faults": [
+        {"site": "sock.corrupt", "kind": "corrupt", "times": 2}]})
+    l0, l1, rl = _pair()
+    try:
+        _xfer(l0, l1)
+    finally:
+        _close(l0, l1, rl)
+        fi.clear()
+    delta = metrics()
+    assert delta.get('hvd_hop_retries_total{cause="corrupt"}', 0) >= 1, \
+        delta
+
+
+def test_rung2_reset_reconnect_resume(metrics):
+    """An injected RST drops the data socket mid-stream; the lower rank
+    re-dials through the kept-open listener, both sides RESUME, and the
+    sender replays everything past the peer's cursor."""
+    fi.configure({"faults": [
+        {"site": "sock.reset", "kind": "error", "times": 1}]})
+    l0, l1, rl = _pair()
+    try:
+        _xfer(l0, l1)
+    finally:
+        _close(l0, l1, rl)
+        fi.clear()
+    delta = metrics()
+    assert delta.get("hvd_peer_reconnects_total", 0) >= 1, delta
+    assert delta.get('hvd_hop_retries_total{cause="reset"}', 0) >= 1, \
+        delta
+
+
+def test_rung3_shm_fault_fails_over_to_tcp(metrics):
+    """A faulted shm ring demotes the pair to its idle mesh TCP socket
+    in place — no rebootstrap, no eviction, stream intact."""
+    fi.configure({"faults": [
+        {"site": "shm.lost", "kind": "error", "times": 1}]})
+    l0, l1, rl = _pair(shm=True)
+    try:
+        _xfer(l0, l1)
+        assert (l0._mode, l1._mode) == ("tcp", "tcp")
+    finally:
+        _close(l0, l1, rl)
+        fi.clear()
+    delta = metrics()
+    assert delta.get("hvd_transport_failovers_total", 0) >= 1, delta
+    assert delta.get('hvd_hop_retries_total{cause="failover"}', 0) >= 1, \
+        delta
+
+
+def test_rung4_exhaustion_raises_typed_corruption(monkeypatch, metrics):
+    """With the NACK budget at zero and every frame corrupted, the
+    ladder gives up with the typed error the engine escalates into the
+    PR-6 gang abort."""
+    monkeypatch.setenv(env_util.HOP_RETRIES, "0")
+    fi.configure({"faults": [
+        {"site": "sock.corrupt", "kind": "corrupt"}]})
+    l0, l1, rl = _pair()
+    try:
+        l0.wait(l0.send(b"z" * 256), timeout=10)
+        with pytest.raises(wire.WireCorruptionError) as ei:
+            l1.recv_frame(time.monotonic() + 10)
+        assert ei.value.peer == 0
+        assert ei.value.cause == "corrupt"
+    finally:
+        fi.clear()
+        _close(l0, l1, rl)
+
+
+def test_ladder_payloads_larger_than_retention_window(metrics):
+    """More in-flight frames than HVD_LADDER_RETAIN retains: a healthy
+    link must not need the retired copies; only a retry past the window
+    poisons (covered by the exhaustion test)."""
+    l0, l1, rl = _pair()
+    try:
+        _xfer(l0, l1, n=env_util.ladder_retain() + 8, size=512)
+    finally:
+        _close(l0, l1, rl)
+
+
+# ---------------------------------------------------------------------------
+# acceptance gangs
+# ---------------------------------------------------------------------------
+
+SOAK_SEED = 1234
+SOAK_RATE = 0.05
+
+
+def _gang_env(rank, np_, port):
+    env = dict(os.environ)
+    env.pop(fi.ENV_VAR, None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({
+        "HVD_RANK": str(rank),
+        "HVD_SIZE": str(np_),
+        "HVD_LOCAL_RANK": str(rank),
+        "HVD_LOCAL_SIZE": str(np_),
+        "HVD_CROSS_RANK": "0",
+        "HVD_CROSS_SIZE": "1",
+        "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+        "HVD_RENDEZVOUS_PORT": str(port),
+        "JAX_PLATFORMS": "cpu",
+        "HVD_TPU_CORE": "py",
+        "HVD_EXPECT_ENGINE": "PyEngine",
+        "HVD_WIRE_CRC": "1",
+        "HVD_ELASTIC_EPOCH": "0",
+        "HVD_ELASTIC_MIN_NP": "2",
+        "HVD_ELASTIC_MAX_NP": str(np_),
+        "HVD_ELASTIC_UID": f"uid-{rank}",
+        "HVD_ELASTIC_CHECK_INTERVAL_S": "0.05",
+    })
+    return env
+
+
+def _steps(out):
+    return {int(m.group(1)): float(m.group(2))
+            for m in re.finditer(r"STEP (\d+) ([\d.]+)", out)}
+
+
+def _parse_cte(out):
+    m = re.search(r"CTE ranks=(\[[^\]]*\]) tensor=(\S+)", out)
+    return (json.loads(m.group(1)), m.group(2)) if m else None
+
+
+def _grad(rank, step, j, n=8):
+    # Mirror of ladder_worker.grad — the oracle inputs.
+    return (np.arange(n, dtype=np.float32) * (j + 1)
+            + 10.0 * rank + 100.0 * step).astype(np.float32)
+
+
+@pytest.mark.timeout(300)
+def test_ladder_chaos_soak_bit_identical(tmp_path):
+    """The acceptance soak: a 3-rank gang over MIXED transports (pair
+    (0,1) on shm rings, everyone's pairs with rank 2 on TCP) trains
+    under the seedable randomized chaos schedule sweeping sock.corrupt,
+    sock.reset and shm.lost.  The ladder must absorb every injected
+    fault: all steps bit-identical to the fault-free oracle (asserted
+    in-process by each worker), zero evictions / ELASTIC_REFORM /
+    COLLECTIVE_ABORT even with the collective deadline ARMED, retries
+    observable in the counters with their cause, and HOP_RETRY /
+    TRANSPORT_FAILOVER first-class on rank 0's timeline."""
+    np_ = 3
+    tl_path = tmp_path / "ladder_timeline.json"
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = _gang_env(rank, np_, port)
+            env.update({
+                fi.ENV_VAR: f"random:{SOAK_SEED}:{SOAK_RATE}",
+                "HVD_METRICS": "1",
+                # Armed, generous: recovery must finish far below it —
+                # an abort here means a rung failed to heal.
+                "HVD_COLLECTIVE_TIMEOUT": "30",
+                "HVD_RECONNECT_TIMEOUT_S": "10",
+            })
+            if rank == 2:
+                env["HVD_SHM_DISABLE"] = "1"
+            if rank == 0:
+                env["HVD_TIMELINE"] = str(tl_path)
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, "soak"], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        outs = {}
+        for rank, p in enumerate(procs):
+            out, err = p.communicate(timeout=240)
+            outs[rank] = (p.returncode, out.decode(), err.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    counters = {}
+    for rank in range(np_):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        # Mixed topology actually paired: shm between 0 and 1, TCP to 2.
+        m = re.search(r"MODES (\{.*\})", out)
+        assert m, (rank, out)
+        modes = json.loads(m.group(1))
+        want = {str(p): ("shm" if {rank, p} == {0, 1} else "tcp")
+                for p in range(np_) if p != rank}
+        assert modes == want, (rank, modes, want)
+        # Every step completed on the full gang with the oracle value
+        # (element 0 of grad.a summed over 3 ranks: 30 + 300*step).
+        steps = _steps(out)
+        assert steps == {s: 30.0 + 300.0 * s for s in range(12)}, \
+            (rank, steps)
+        assert f"DONE {rank}" in out, (rank, out)
+        sm = re.search(r"SNAP (\{.*\})", out)
+        assert sm, (rank, out)
+        for k, v in json.loads(sm.group(1)).items():
+            counters[k] = counters.get(k, 0.0) + v
+
+    # The chaos actually bit and rung 1 healed it: retries > 0, each
+    # series naming its cause label.
+    retry_series = {k: v for k, v in counters.items()
+                    if k.startswith("hvd_hop_retries_total")}
+    assert retry_series and all("cause=" in k for k in retry_series), \
+        counters
+    assert sum(retry_series.values()) > 0, counters
+    # shm.lost fired somewhere across the soak, so the (0,1) pair must
+    # have demoted to TCP in place — one failover per side.
+    assert counters.get("hvd_transport_failovers_total", 0) >= 1, \
+        counters
+
+    # Timeline: healing is first-class, escalation never happened.
+    tl = tl_path.read_text()
+    assert "HOP_RETRY" in tl, tl[-2000:]
+    assert "TRANSPORT_FAILOVER" in tl, tl[-2000:]
+    assert "COLLECTIVE_ABORT" not in tl
+    assert "ELASTIC_REFORM" not in tl
+
+
+@pytest.mark.timeout(300)
+def test_ladder_exhaustion_escalates_to_gang_abort(tmp_path):
+    """The bottom rung: a rank that corrupts EVERY frame it sends burns
+    its neighbor's NACK budget, the neighbor's typed WireCorruptionError
+    escalates into the PR-6 agreement, and the gang evicts the corruptor
+    — not the innocent neighbors — then replays the aborted fused batch
+    bit-identically from the survivors' retained inputs.
+
+    The victim runs with a 30 s collective deadline (vs the survivors'
+    2 s) so it never self-reports: the verdict must rest on the
+    corruption evidence reaching the coordinator, proving the
+    WireCorruptionError path — not a generic timeout — drove the abort.
+    """
+    np_, victim = 3, 1
+    tl_path = tmp_path / "exhaust_timeline.json"
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = _gang_env(rank, np_, port)
+            env.update({
+                "HVD_SHM_DISABLE": "1",     # pure-TCP: rung 1 only
+                "HVD_HOP_RETRIES": "2",     # small, fast NACK budget
+                "HVD_COLLECTIVE_PROBE_TIMEOUT": "0.5",
+                "HVD_COLLECTIVE_TIMEOUT": "2",
+            })
+            if rank == victim:
+                env["HVD_COLLECTIVE_TIMEOUT"] = "30"
+                env["LADDER_VICTIM"] = "1"
+                # Don't chase the re-formed survivors for long.
+                env["HVD_RECONNECT_TIMEOUT_S"] = "2"
+            if rank == 0:
+                env["HVD_TIMELINE"] = str(tl_path)
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, "exhaust"], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+        outs = {}
+        deadline = time.monotonic() + 120.0
+        for rank in range(np_):
+            if rank == victim:
+                continue
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = procs[rank].communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"survivor rank {rank} hung: the gang-wide abort "
+                    "never released it")
+            outs[rank] = (procs[rank].returncode, out.decode(),
+                          err.decode())
+        # The verdict kills the victim's background loop, but its elastic
+        # wrapper then blocks re-rendezvousing into a gang that has moved
+        # on — same as PR-6's wedged victim, it never exits on its own.
+        # Give it a short grace, then put it down like an operator would.
+        t0 = time.monotonic()
+        while procs[victim].poll() is None and time.monotonic() - t0 < 3:
+            time.sleep(0.2)
+        if procs[victim].poll() is None:
+            procs[victim].kill()
+        v_out, v_err = procs[victim].communicate(timeout=30)
+        outs[victim] = (procs[victim].returncode, v_out.decode(),
+                        v_err.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    # -- the corruptor: evicted, never finished --------------------------
+    v_code, v_out, v_err = outs[victim]
+    assert v_code != 0, (v_code, v_out, v_err)
+    assert "DONE" not in v_out, v_out
+    assert dict(_steps(v_out)) == {0: 30.0}, v_out  # full-gang step 0
+
+    # -- the survivors: same typed abort naming the corruptor ------------
+    replays = {}
+    survivors = [r for r in range(np_) if r != victim]
+    for rank in survivors:
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        cte = _parse_cte(out)
+        assert cte is not None, (rank, out, err)
+        ranks, tensor = cte
+        assert ranks == [victim], (rank, cte)
+        steps = _steps(out)
+        # Step 0 over the full gang (sum of 10r = 30); steps 1-3 re-run
+        # over the re-formed {0,2} gang (10*(0+2) + 200*step).
+        assert steps == {0: 30.0, 1: 220.0, 2: 420.0, 3: 620.0}, \
+            (rank, steps)
+        assert f"DONE {rank}" in out, (rank, out)
+        replays[rank] = {
+            m.group(1): m.group(2)
+            for m in re.finditer(r"REPLAY (\S+) ([0-9a-f]+)", out)}
+    assert _parse_cte(outs[survivors[0]][1])[1] == \
+        _parse_cte(outs[survivors[1]][1])[1]
+
+    # -- evict-and-replay: bit-identical to the survivors' fused oracle --
+    assert replays[survivors[0]] == replays[survivors[1]], replays
+    assert len(replays[survivors[0]]) == 3, replays
+    for j, nm in enumerate(("grad.a", "grad.b", "grad.c")):
+        matches = [k for k in replays[survivors[0]] if f"{nm}.s1" in k]
+        assert len(matches) == 1, (nm, replays)
+        oracle = (_grad(0, 1, j) + _grad(2, 1, j)).tobytes().hex()
+        assert replays[survivors[0]][matches[0]] == oracle, (nm, replays)
+
+    # -- the escalation is the EXACT PR-6 machinery ----------------------
+    tl = tl_path.read_text()
+    assert "COLLECTIVE_ABORT" in tl, tl[-2000:]
+    assert "ELASTIC_REFORM" in tl, tl[-2000:]

@@ -79,10 +79,12 @@ def test_response_roundtrip():
 
 
 def test_response_list_params_roundtrip():
+    # Encoding a legacy 5-tuple is still accepted; the decoder always
+    # yields the full 6-tuple, with ring_segment_bytes defaulting to 0.
     data = wire.encode_response_list(
         [], params=(32 << 20, 0.0035, False, True, False))
     _, _, _, _, params, _ = wire.decode_response_list(data)
-    assert params == (32 << 20, 0.0035, False, True, False)
+    assert params == (32 << 20, 0.0035, False, True, False, 0)
 
 
 def test_response_shapes_roundtrip():

@@ -1,0 +1,88 @@
+#!/usr/bin/env python3
+"""Lint: every registered fault-injection site must be exercised by at
+least one test.
+
+tools/check_fault_sites.py guarantees the registry and the docs agree
+with the *production* call sites; this checker closes the remaining gap:
+a site can be registered, documented, and wired into the package yet
+never actually pulled in anger by the suite — a chaos hook nobody has
+proven fires.  The contract (wired in as tests/test_fault_coverage.py):
+
+  every ``fault_injection.KNOWN_SITES`` entry must appear, as a
+  word-boundary string, somewhere under ``tests/`` — in a fault plan
+  (``{"site": "sock.reset", ...}``, a ``HOROVOD_FAULT_PLAN`` JSON), a
+  direct ``fi.fire(...)`` exercise, or a driving test's assertion.
+
+The scan is textual on purpose: fault plans are data (JSON env vars,
+dict literals, per-rank plan files written by drivers), so an AST walk
+would miss most real usage.  A site name is distinctive enough
+(``kv.mirror``, ``shm.lost``) that a word-boundary match — dots escaped,
+no letter/digit/dot on either side, the same rule as
+tools/check_fault_sites.py's docs check — has no false positives in
+practice, and a false positive would surface immediately as a site you
+cannot find when you grep for it.
+
+Usage: ``python tools/check_fault_coverage.py`` (exit 1 on violations).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+TESTS_DIR = REPO_ROOT / "tests"
+
+
+def registry() -> dict:
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        from horovod_tpu.common import fault_injection
+    finally:
+        sys.path.pop(0)
+    return fault_injection.known_sites()
+
+
+def exercised_sites(tests_dir: Path = TESTS_DIR) -> dict:
+    """``{site: [relpath, ...]}`` for every registered site that appears
+    (word-boundary) in at least one file under ``tests_dir``."""
+    import os
+
+    known = sorted(registry())
+    pats = {s: re.compile(rf"(?<![\w.]){re.escape(s)}(?![\w.])")
+            for s in known}
+    out: dict = {}
+    for py in sorted(tests_dir.rglob("*.py")):
+        text = py.read_text(encoding="utf-8")
+        rel = os.path.relpath(str(py), str(REPO_ROOT))
+        for site, pat in pats.items():
+            if pat.search(text):
+                out.setdefault(site, []).append(rel)
+    return out
+
+
+def unexercised_sites(tests_dir: Path = TESTS_DIR) -> list:
+    hit = exercised_sites(tests_dir)
+    return [s for s in sorted(registry()) if s not in hit]
+
+
+def main() -> int:
+    missing = unexercised_sites()
+    if missing:
+        print("registered fault sites never exercised by any test:",
+              file=sys.stderr)
+        for site in missing:
+            print(f"  {site!r}  ({registry()[site]})", file=sys.stderr)
+        print("add a test that drives each site — a fault plan naming "
+              "it, or a direct fire()/should_corrupt() exercise "
+              "(see tests/test_fault_coverage.py).", file=sys.stderr)
+        return 1
+    counted = exercised_sites()
+    print(f"ok: all {len(counted)} registered fault sites are exercised "
+          f"by the test suite")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
