@@ -586,6 +586,34 @@ def main() -> None:
     except Exception as e:
         extras["transport_bench_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # --- gang-wide tracing: phase-attributed eager allreduce ------------
+    # The same fused-gradient workload once more with HVD_TRACE=1: every
+    # rank streams spans, tools/hvd_trace.py reduces them to mean
+    # ms-per-collective per phase, and the block rides the snapshot so
+    # tools/check_bench_regression.py can name the phase that moved when
+    # the throughput gate trips (docs/timeline.md "Gang-wide tracing").
+    try:
+        import tempfile
+
+        from horovod_tpu.runner.run import run as hvd_run
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import hvd_trace
+
+        tr_counts, tr_iters, tr_batch = [1 << 18] * 4, 10, 32
+        with tempfile.TemporaryDirectory(prefix="hvd-bench-trace-") as td:
+            hvd_run(_eager_allreduce_images_worker,
+                    (tr_iters, tr_counts, tr_batch), np=8,
+                    env={"HVD_TPU_CORE": "py", "JAX_PLATFORMS": "cpu",
+                         "HVD_TRACE": "1", "HVD_TRACE_DIR": td})
+            rep = hvd_trace.analyze_dir(td)
+        if rep is not None:
+            extras["phase_breakdown"] = rep["phase_breakdown_ms"]
+            extras["trace_num_collectives"] = rep["num_collectives"]
+    except Exception as e:
+        extras["trace_bench_error"] = f"{type(e).__name__}: {e}"[:200]
+
     baseline = 1656.82 / 16.0  # reference's per-device number
     line = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip"

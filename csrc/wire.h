@@ -133,6 +133,15 @@ constexpr uint8_t kTagResume = 12;
 constexpr uint8_t kTagFailover = 13;
 constexpr size_t kDataTrailerBytes = 8;
 
+// Control tags 14-15 are reserved by the Python engine's gang-wide
+// tracing clock sync (HVD_TRACE=1; telemetry/trace.py):
+// kTagClockPing = 14 (i64 t0_ns, u32 epoch), kTagClockPong = 15
+// (i64 t0_ns, i64 t_coord_ns, u32 epoch).  Like the abort tags, these
+// frames never reach a native engine — a traced gang must be
+// all-Python (docs/timeline.md "Gang-wide tracing").
+constexpr uint8_t kTagClockPing = 14;
+constexpr uint8_t kTagClockPong = 15;
+
 // CRC-32 (zlib polynomial), seed 0 — matches Python's zlib.crc32.
 uint32_t WireCrc32(const uint8_t* data, size_t len, uint32_t crc = 0);
 

@@ -91,6 +91,8 @@ KNOWN_SITES = {
     "shm.lost": "shm ring faults mid-gang (reader gone / attach lost)",
     "shm.stall": "data-plane shm ring receive (hang simulation)",
     "shm.attach": "shm segment attach during transport pairing",
+    "trace.emit": "trace span-file write (a dropped/failed write must "
+                  "never affect training)",
     "train.step": "user-level per-step site (training scripts)",
     "serve.admit": "serving front-door admission (HTTP 503 shedding)",
     "serve.step": "serving decode step, every rank (stall/delay sim)",

@@ -69,6 +69,19 @@ reservation is noted in csrc/wire.h):
   ProbeAck     := u8 busy, f64 busy_seconds, u32 epoch
   AbortVerdict := varstr tensor_name, u32 n, i32 ranks[n], u32 epoch
 
+Trace clock-sync payloads (Python engine only, like the abort tags —
+ridden on ``TAG_CLOCK_PING`` / ``TAG_CLOCK_PONG``, reserved as tags
+14/15 in csrc/wire.h; frames only flow when ``HVD_TRACE`` is set, so a
+traced gang must be all-Python — docs/timeline.md "Gang-wide tracing"):
+
+  ClockPing := i64 t0_ns, u32 epoch      # worker's monotonic clock
+  ClockPong := i64 t0_ns, i64 t_coord_ns, u32 epoch   # t0 echoed back
+
+The worker timestamps the ping (``t0_ns``), the coordinator answers
+from its ctrl recv thread with its own monotonic read, and the worker
+computes ``offset = t_coord − (t0 + t1)/2`` at receive time ``t1`` —
+the NTP midpoint method, accurate to half the control-channel RTT.
+
 Recovery-ladder framing (``HVD_WIRE_CRC=1`` only — docs/fault_tolerance.md
 "recovery ladder"; tag numbers 11-13 and the trailer layout are reserved
 in csrc/wire.h, which the native engine must mirror before it can join a
@@ -407,6 +420,31 @@ def decode_abort_verdict(data: bytes) -> Tuple[str, List[int], int]:
         ranks.append(r)
     (epoch,) = struct.unpack_from("<I", data, off)
     return name, ranks, epoch
+
+
+# -- trace clock sync (docs/timeline.md "Gang-wide tracing") ------------
+
+
+def encode_clock_ping(t0_ns: int, epoch: int = 0) -> bytes:
+    """Worker -> coordinator: this rank's monotonic clock, now."""
+    return struct.pack("<qI", t0_ns, epoch)
+
+
+def decode_clock_ping(data: bytes) -> Tuple[int, int]:
+    t0_ns, epoch = struct.unpack_from("<qI", data, 0)
+    return t0_ns, epoch
+
+
+def encode_clock_pong(t0_ns: int, t_coord_ns: int,
+                      epoch: int = 0) -> bytes:
+    """Coordinator -> worker: the ping's t0 echoed back plus the
+    coordinator's own monotonic clock at answer time."""
+    return struct.pack("<qqI", t0_ns, t_coord_ns, epoch)
+
+
+def decode_clock_pong(data: bytes) -> Tuple[int, int, int]:
+    t0_ns, t_coord_ns, epoch = struct.unpack_from("<qqI", data, 0)
+    return t0_ns, t_coord_ns, epoch
 
 
 # -- recovery-ladder framing (docs/fault_tolerance.md) ------------------

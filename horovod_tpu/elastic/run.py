@@ -60,6 +60,7 @@ from horovod_tpu.elastic.driver import (
     HostsUpdatedInterrupt,
 )
 from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.telemetry import trace as _trace
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
@@ -203,6 +204,7 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     """Tear down, compute the new world, and re-init under a new epoch."""
     from horovod_tpu import basics, process_sets
 
+    t_reform0 = time.monotonic_ns()
     if 0 in failed:
         _tmx.inc_counter("hvd_leader_failovers_total")
     _timeline_event("ELASTIC_RESET", failed=sorted(failed))
@@ -268,6 +270,11 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
         _timeline_event("LEADER_FAILOVER", failed=sorted(failed),
                         epoch=ctx.epoch - 1, new_leader=new_rank == 0)
     _timeline_event("ELASTIC_REFORM", epoch=new_epoch, size=len(world))
+    # Emitted AFTER basics.init(): the re-formed engine's tracer (a
+    # fresh file under the same HVD_TRACE_DIR, appended by epoch) is
+    # the one that exists to record it.
+    _trace.emit("elastic.reform", t_reform0, time.monotonic_ns(),
+                epoch=new_epoch, size=len(world), failed=sorted(failed))
     ctx.log.info("gang re-formed: epoch %d, rank %d/%d",
                  new_epoch, new_rank, len(world))
 
@@ -309,6 +316,7 @@ def _replay_aborted_batch(ctx: _ElasticContext,
     # Async-submit the whole batch so the coordinator re-fuses it like
     # the original launch; names are epoch-scoped so the replay never
     # collides with the training loop's own tensor names.
+    t_replay0 = time.monotonic_ns()
     handles = [
         (item["name"], eager.allreduce_async(
             item["array"], name=f"replay.e{ctx.epoch}.{item['name']}",
@@ -318,6 +326,8 @@ def _replay_aborted_batch(ctx: _ElasticContext,
     _last_replay = {nm: eager.synchronize(h) for nm, h in handles}
     _timeline_event("ELASTIC_REPLAY", epoch=ctx.epoch,
                     tensors=len(handles))
+    _trace.emit("elastic.replay", t_replay0, time.monotonic_ns(),
+                epoch=ctx.epoch, tensors=len(handles))
     ctx.log.info("replayed %d aborted tensor(s) on the re-formed gang",
                  len(handles))
 

@@ -258,14 +258,19 @@ def _combine_into(incoming: np.ndarray, mine: np.ndarray, op: ReduceOp,
 def _recv_combine(tr: tpt.Transport, mine: np.ndarray, hop: np.ndarray,
                   hop_mv: memoryview, op: ReduceOp, seg: int,
                   fb: FusionBuffer, deadline: Optional[float] = None,
-                  peer: int = -1) -> None:
+                  peer: int = -1,
+                  reduce_ns: Optional[list] = None) -> None:
     """Receive one hop's chunk and reduce it into ``mine`` in place.
 
     With ``seg`` > 0, the payload is drained in ``seg``-element slices:
     while numpy reduces slice k, the peer (kernel socket buffer or shm
     ring writer) keeps producing slice k+1 — the DeAR-style
     transfer/reduction overlap, with no extra threads and no
-    wire-format change."""
+    wire-format change.
+
+    ``reduce_ns`` (tracing only — None on the untraced hot path) is a
+    one-element accumulator that separates reduction time from wire
+    wait inside this combined receive."""
     nbytes = _recv_data_header(tr, deadline, peer)
     n = mine.size
     isz = mine.itemsize
@@ -277,14 +282,24 @@ def _recv_combine(tr: tpt.Transport, mine: np.ndarray, hop: np.ndarray,
         return
     if seg <= 0 or seg >= n:
         _recv_exact_hop(tr, hop_mv[:nbytes], deadline, peer)
-        _combine_into(hop[:n], mine, op, fb)
+        if reduce_ns is None:
+            _combine_into(hop[:n], mine, op, fb)
+        else:
+            r0 = time.monotonic_ns()
+            _combine_into(hop[:n], mine, op, fb)
+            reduce_ns[0] += time.monotonic_ns() - r0
         return
     done = 0
     while done < n:
         k = min(seg, n - done)
         _recv_exact_hop(tr, hop_mv[done * isz:(done + k) * isz],
                         deadline, peer)
-        _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
+        if reduce_ns is None:
+            _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
+        else:
+            r0 = time.monotonic_ns()
+            _combine_into(hop[done:done + k], mine[done:done + k], op, fb)
+            reduce_ns[0] += time.monotonic_ns() - r0
         done += k
 
 
@@ -332,32 +347,55 @@ def _ring_allreduce_group(engine, flat: np.ndarray, op: ReduceOp,
     hop_mv = memoryview(hop.view(np.uint8))
     seg = _segment_elems(engine, dtype.itemsize)
     timed = _tmx.enabled()
+    tracer = getattr(engine, "_tracer", None)
+    # Tracing-only reduce-time accumulator threaded into _recv_combine;
+    # None keeps the untraced hot path allocation-identical (pinned by
+    # tests/test_dataplane.py steady-state tracemalloc test).
+    rns = [0] if tracer is not None else None
 
     # Phase 1: ring reduce-scatter.
     for step in range(size - 1):
         t0 = time.perf_counter() if timed else 0.0
+        tr0 = time.monotonic_ns() if tracer is not None else 0
         send_idx = (me - step) % size
         recv_idx = (me - step - 1) % size
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
         _recv_combine(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
-                      hop, hop_mv, op, seg, fb, deadline, left_rank)
+                      hop, hop_mv, op, seg, fb, deadline, left_rank,
+                      reduce_ns=rns)
+        tr1 = time.monotonic_ns() if tracer is not None else 0
         _wait_send(right, ticket, deadline, right_rank)
         if timed:
             _tmx.observe("hvd_ring_hop_seconds",
                          time.perf_counter() - t0, ("reduce_scatter",))
+        if tracer is not None:
+            tr2 = time.monotonic_ns()
+            tracer.span("hop", tr0, tr2, ring="reduce_scatter", hop=step,
+                        peer=left_rank, tp=left.kind,
+                        recv_ns=tr1 - tr0 - rns[0], reduce_ns=rns[0],
+                        send_wait_ns=tr2 - tr1)
+            rns[0] = 0
 
     # Phase 2: ring allgather of the reduced chunks, straight into place.
     for step in range(size - 1):
         t0 = time.perf_counter() if timed else 0.0
+        tr0 = time.monotonic_ns() if tracer is not None else 0
         send_idx = (me + 1 - step) % size
         recv_idx = (me - step) % size
         ticket = right.send(flat[bounds[send_idx]:bounds[send_idx + 1]])
         _recv_into(left, flat[bounds[recv_idx]:bounds[recv_idx + 1]],
                    deadline, left_rank)
+        tr1 = time.monotonic_ns() if tracer is not None else 0
         _wait_send(right, ticket, deadline, right_rank)
         if timed:
             _tmx.observe("hvd_ring_hop_seconds",
                          time.perf_counter() - t0, ("allgather",))
+        if tracer is not None:
+            tr2 = time.monotonic_ns()
+            tracer.span("hop", tr0, tr2, ring="allgather", hop=step,
+                        peer=left_rank, tp=left.kind,
+                        recv_ns=tr1 - tr0, reduce_ns=0,
+                        send_wait_ns=tr2 - tr1)
 
     return flat
 
@@ -547,7 +585,12 @@ def allreduce(engine, entries, resp: Response):
     postscale = resp.postscale_factor
     dtype = _np_dtype(resp.tensor_type)
     fb = _scratch(engine)
+    tracer = getattr(engine, "_tracer", None)
+    tp0 = time.monotonic_ns() if tracer is not None else 0
     flat = fb.pack(entries, dtype)
+    if tracer is not None:
+        tracer.span("pack", tp0, time.monotonic_ns(),
+                    tensors=len(entries), nbytes=int(flat.nbytes))
     fused = True
     if prescale != 1.0:
         if _needs_f32_math(dtype):
@@ -574,7 +617,12 @@ def allreduce(engine, entries, resp: Response):
         fused = False
     if fused:
         reduced = reduced.copy()
-    return fb.unpack(reduced, entries)
+    tu0 = time.monotonic_ns() if tracer is not None else 0
+    out = fb.unpack(reduced, entries)
+    if tracer is not None:
+        tracer.span("unpack", tu0, time.monotonic_ns(),
+                    tensors=len(entries))
+    return out
 
 
 def _allgather_hierarchical(engine, entries, resp: Response):

@@ -58,6 +58,7 @@ from horovod_tpu.common.types import (
 from horovod_tpu.common.types import dtype_from_numpy, dtype_to_numpy_name
 from horovod_tpu import telemetry as _telemetry
 from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.telemetry import trace as trace_mod
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 from horovod_tpu.utils import timeline as timeline_mod
@@ -258,6 +259,7 @@ class SingleProcessEngine(_EngineBase):
         super().__init__(0, 1, 0, 1, 0, 1)
         self.timeline = timeline_mod.from_env(0)
         _telemetry.init_from_env(0, 0)
+        self._tracer = None  # tracing needs a gang; see PyEngine
         # Serving surface (serving/loop.py): a broadcast to a gang of
         # one is a local enqueue, so the loop's drive/apply split works
         # unchanged single-process.
@@ -409,6 +411,17 @@ class PyEngine(_EngineBase):
                 self._straggler = _telemetry.StragglerDetector(
                     env_util.get_float(env_util.STRAGGLER_WARN_MS, 0.0),
                     size)
+
+        # Gang-wide tracing (telemetry/trace.py; docs/timeline.md "Gang-
+        # wide tracing").  Unlike the rank-0 timeline, EVERY rank traces;
+        # None when HVD_TRACE is unset, and all hot-path hooks are one
+        # attribute load + None check.
+        self._tracer = trace_mod.from_env(rank)
+        self._clock_sync_cycles = env_util.trace_clock_sync_cycles()
+        self._clock_ping_countdown = 0  # 0 = ping on the next cycle
+        if self._tracer is not None and rank == 0:
+            # The coordinator defines the gang clock axis: offset 0.
+            self._tracer.clock(0, 0)
 
         # request queue (tensor queue) + tensor table
         self._queue_lock = threading.Lock()
@@ -610,6 +623,19 @@ class PyEngine(_EngineBase):
                     with self._abort_lock:
                         self._abort_inbox.append(
                             (peer_rank, tag, payload))
+                elif tag == su.TAG_CLOCK_PING:
+                    # Trace clock sync (telemetry/trace.py): echo the
+                    # worker's t0 with our monotonic read.  Answered
+                    # from THIS thread so the estimate never waits on a
+                    # busy background cycle (cf. TAG_PROBE below).
+                    t0_ns, pepoch = wire.decode_clock_ping(payload)
+                    pong = wire.encode_clock_pong(
+                        t0_ns, time.monotonic_ns(), pepoch)
+                    try:
+                        with self._ctrl_send_lock:
+                            su.send_frame(sock, su.TAG_CLOCK_PONG, pong)
+                    except (ConnectionError, OSError):
+                        pass  # liveness machinery owns the eviction
         except (ConnectionError, OSError):
             # EOF/reset: fast liveness signal, stronger than a missed
             # heartbeat (only acted on when heartbeats are enabled).
@@ -648,6 +674,18 @@ class PyEngine(_EngineBase):
                     with self._serve_cv:
                         self._serve_inbox.append(payload)
                         self._serve_cv.notify_all()
+                elif tag == su.TAG_CLOCK_PONG:
+                    # Midpoint method: offset maps this rank's monotonic
+                    # axis onto rank 0's (add offset to local times).
+                    t1_ns = time.monotonic_ns()
+                    t0_ns, tc_ns, pepoch = wire.decode_clock_pong(payload)
+                    tr = self._tracer
+                    if tr is not None and pepoch == self.epoch:
+                        offset_ns = tc_ns - (t0_ns + t1_ns) // 2
+                        tr.clock(offset_ns, t1_ns - t0_ns)
+                        if self._metrics_on:
+                            _tmx.set_gauge("hvd_trace_clock_skew_seconds",
+                                           offset_ns / 1e9)
         except (ConnectionError, OSError):
             # Coordinator EOF/reset.  During a negotiated shutdown (or
             # after our own close) this is expected teardown noise;
@@ -899,6 +937,8 @@ class PyEngine(_EngineBase):
         self._shutdown_flag.set()
         self._bg.join(timeout=10)
         self.timeline.shutdown()
+        trace_mod.release(self._tracer)
+        self._tracer = None
         # Stop the persistent senders first (drains queued frames while
         # the sockets are still open), then close sockets — which also
         # unblocks any sender stuck mid-write to a dead peer — and join.
@@ -1073,7 +1113,26 @@ class PyEngine(_EngineBase):
 
     # -- worker ---------------------------------------------------------
 
+    def _maybe_clock_ping(self) -> None:
+        """Tracing only: piggyback a clock-offset ping on the ctrl
+        channel at bootstrap and every HVD_TRACE_CLOCK_SYNC_CYCLES
+        worker cycles (docs/timeline.md "Gang-wide tracing")."""
+        n = self._clock_ping_countdown
+        if n > 0:
+            self._clock_ping_countdown = n - 1
+            return
+        self._clock_ping_countdown = self._clock_sync_cycles
+        try:
+            ping = wire.encode_clock_ping(time.monotonic_ns(), self.epoch)
+            with self._ctrl_send_lock:
+                su.send_frame(self._ctrl_sock, su.TAG_CLOCK_PING, ping)
+            self._last_send = time.monotonic()
+        except (ConnectionError, OSError):
+            pass  # a dead hub surfaces through the recv loop
+
     def _worker_cycle(self, msgs: List[Request]) -> bool:
+        if self._tracer is not None:
+            self._maybe_clock_ping()
         requests, hit_events = self._classify(msgs)
         want_shutdown = self._shutdown_requested.is_set()
         send_failed = False
@@ -1977,6 +2036,20 @@ class PyEngine(_EngineBase):
         entries = self._get_entries(resp)
         op_name = resp.response_type.name
         self.timeline.start(resp.tensor_names[0], op_name)
+        tracer = self._tracer
+        if tracer is not None:
+            # One collective seq per executed response: responses run
+            # serially in response-stream order, identically on every
+            # rank, so the counter needs no wire traffic to agree.
+            seq = tracer.begin_collective()
+            t_exec0 = time.monotonic_ns()
+            first_enq = min((e.enqueue_ns for e in entries
+                             if e.handle >= 0), default=0)
+            if first_enq:
+                # Negotiation latency: first local enqueue -> execution.
+                tracer.span("negotiate", first_enq, t_exec0, seq=seq,
+                            name=resp.tensor_names[0], op=op_name,
+                            tensors=len(entries))
         deadline_on = self.collective_timeout > 0
         if deadline_on:
             # Busy marker for probe acks: the recv thread reads it to
@@ -2031,10 +2104,21 @@ class PyEngine(_EngineBase):
         if deadline_on:
             self._in_collective_since = 0.0
         self.timeline.end(resp.tensor_names[0])
+        if tracer is not None:
+            t_cb0 = time.monotonic_ns()
         for e, res in zip(entries, results):
             self._release_name(e.name)
             if e.handle >= 0:
                 self.handles.mark_done(e.handle, status, res)
+        if tracer is not None:
+            t_end = time.monotonic_ns()
+            tracer.span("callback", t_cb0, t_end, seq=seq,
+                        tensors=len(entries))
+            # Envelope span: contains pack/hop/unpack/callback in the
+            # merged view; "negotiate" precedes it on the same seq.
+            tracer.span("collective", t_exec0, t_end, seq=seq,
+                        name=resp.tensor_names[0], op=op_name,
+                        ok=status.ok_())
 
     def cache_stats(self) -> Dict[str, int]:
         return self._cache.stats()

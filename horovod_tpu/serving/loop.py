@@ -326,6 +326,8 @@ class ServingLoop:
         # Chaos: a mid-decode stall/delay on this rank, fired before any
         # device work so the step's collective shows the gap.
         _fi.fire("serve.step", str(seq))
+        tr = getattr(eng, "_tracer", None)
+        ta0 = time.monotonic_ns() if tr is not None else 0
         t0 = time.monotonic()
         for slot, req_id, max_new, prompt in admissions:
             first = engine.prefill(slot, prompt)
@@ -335,12 +337,22 @@ class ServingLoop:
             self._emit(slot, first, engine, rank0)
         if self._slots:
             toks = engine.step()
+            tc0 = time.monotonic_ns() if tr is not None else 0
             self._confirm(toks)
+            if tr is not None:
+                # The agreement allreduce's own collective spans share
+                # this step's wall window; the serve.confirm span ties
+                # them to the TAG_SERVE seq that caused them.
+                tr.span("serve.confirm", tc0, time.monotonic_ns(),
+                        step=seq, slots=len(self._slots))
             for slot in sorted(self._slots):
                 self._emit(slot, int(toks[slot]), engine, rank0)
             if rank0:
                 _tmx.observe("hvd_serve_token_latency_seconds",
                              time.monotonic() - t0)
+        if tr is not None:
+            tr.span("serve.apply", ta0, time.monotonic_ns(), step=seq,
+                    admitted=len(admissions))
         return False
 
     def _emit(self, slot: int, token: int, engine: DecodeEngine,

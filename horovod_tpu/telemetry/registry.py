@@ -140,6 +140,13 @@ KNOWN_METRICS: Dict[str, dict] = {
         "is promoted."),
     "hvd_nonfinite_skips_total": _counter(
         "Steps skipped by the agreed non-finite gradient guard."),
+    # -- gang-wide tracing (telemetry/trace.py; docs/timeline.md) --
+    "hvd_trace_clock_skew_seconds": _gauge(
+        "Latest midpoint-method estimate of this rank's monotonic-clock "
+        "offset from rank 0 (TAG_CLOCK_PING over the control channel)."),
+    "hvd_trace_spans_total": _counter(
+        "Trace spans recorded, by span phase (negotiate, pack, hop, "
+        "unpack, callback, serve.*, elastic.*, ...).", ("phase",)),
     # -- straggler detection (telemetry/straggler.py) --
     "hvd_straggler_skew_seconds": _hist(
         "Negotiation skew: last rank ready minus first rank ready, "

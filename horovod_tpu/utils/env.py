@@ -126,6 +126,18 @@ METRICS_PORT = "HVD_METRICS_PORT"
 METRICS_FILE = "HVD_METRICS_FILE"
 METRICS_INTERVAL = "HVD_METRICS_INTERVAL"
 STRAGGLER_WARN_MS = "HVD_STRAGGLER_WARN_MS"
+# Gang-wide distributed tracing (telemetry/trace.py; docs/timeline.md
+# "Gang-wide tracing").  TRACE=1 makes EVERY rank stream structured
+# spans (negotiate/pack/hop/unpack/callback, serving and elastic steps)
+# to a per-rank JSONL file under TRACE_DIR (default: the working
+# directory), merged/analyzed by tools/hvd_trace.py.  Workers piggyback
+# a clock-offset ping on the control channel at bootstrap and then every
+# TRACE_CLOCK_SYNC_CYCLES background cycles so the merged trace aligns
+# per-rank monotonic clocks.  Unset (default) = provably zero-cost: no
+# spans, no clock frames, allocation/syscall-identical hot path.
+TRACE = "HVD_TRACE"
+TRACE_DIR = "HVD_TRACE_DIR"
+TRACE_CLOCK_SYNC_CYCLES = "HVD_TRACE_CLOCK_SYNC_CYCLES"
 # Inference serving (horovod_tpu.serving; docs/serving.md).  PORT is the
 # rank-0 HTTP front door (0 = ephemeral); MAX_BATCH is the number of
 # continuous-batching decode slots; MAX_QUEUE bounds the admission queue
@@ -250,6 +262,22 @@ def serve_max_queue() -> int:
     """Admission queue bound (beyond it, /generate sheds with a 503);
     floor 1."""
     return max(1, get_int(SERVE_MAX_QUEUE, 64))
+
+
+def trace_enabled() -> bool:
+    """True when gang-wide tracing is on: every rank streams spans."""
+    return get_bool(TRACE, False)
+
+
+def trace_dir() -> str:
+    """Directory for the per-rank ``trace_rank{R}.jsonl`` span files."""
+    return get_str(TRACE_DIR, ".") or "."
+
+
+def trace_clock_sync_cycles() -> int:
+    """Worker clock-ping cadence in background cycles (floor 1); the
+    first ping goes out on the first cycle regardless."""
+    return max(1, get_int(TRACE_CLOCK_SYNC_CYCLES, 200))
 
 
 def send_wait_cap_s() -> float:

@@ -76,6 +76,7 @@ from typing import Dict, Optional, Tuple
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common import wire
 from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.telemetry import trace as _trace
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 from horovod_tpu.utils import timeline as _tl
@@ -323,10 +324,14 @@ class LadderLink(tpt.Transport):
         _tmx.inc_counter("hvd_hop_retries_total", 1.0, (cause,))
         _tl.engine_event(_tl.HOP_RETRY, peer=self.peer, cause=cause,
                          expected=int(expected), frames=len(frames))
+        t0 = time.monotonic_ns() if _trace.active() else 0
         for f in frames:
             if self._closing or self._poison is not None:
                 return
             self._write_wire(f)
+        if t0:
+            _trace.emit("hop.retry", t0, time.monotonic_ns(),
+                        peer=self.peer, cause=cause, frames=len(frames))
 
     def _write_ctrl(self, tag: int, payload: bytes) -> None:
         """NACKs (TCP rung only).  A write failure here means the socket
@@ -686,6 +691,7 @@ class LadderLink(tpt.Transport):
         _tmx.inc_counter("hvd_transport_failovers_total")
         _tl.engine_event(_tl.TRANSPORT_FAILOVER, peer=self.peer,
                          rank=self.rank)
+        _trace.emit_instant("transport.failover", peer=self.peer, tp="tcp")
         self._fo_done.set()
 
     def _shm_fault(self, exc: BaseException) -> None:

@@ -57,6 +57,13 @@ TAG_SERVE = 10          # coordinator -> workers: serve-step batch delta
 TAG_NACK = 11           # receiver -> sender: retransmit from seq
 TAG_RESUME = 12         # both ways on a reconnected socket: resume point
 TAG_FAILOVER = 13       # both ways on the mesh socket: shm->TCP demotion
+# Gang-wide tracing clock sync (Python engine only, HVD_TRACE=1;
+# telemetry/trace.py, docs/timeline.md "Gang-wide tracing").  Workers
+# ping the coordinator over the ctrl star; the answer aligns per-rank
+# monotonic clocks for the merged trace.  Payload codecs: common/wire.py;
+# values reserved in csrc/wire.h.
+TAG_CLOCK_PING = 14     # worker -> coordinator: my clock, now
+TAG_CLOCK_PONG = 15     # coordinator -> worker: echo + coord clock
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:

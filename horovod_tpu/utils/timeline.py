@@ -65,6 +65,16 @@ STRAGGLER = "STRAGGLER"
 # one syscall per burst instead of one per event.
 _FLUSH_EVERY = 64
 
+# Process-wide clock anchors, captured once at import: every timeline
+# (and every trace.py span stream) in this process shares ONE monotonic
+# base, so streams started at different moments — e.g. the engines of
+# successive elastic incarnations — land on one comparable time axis,
+# immune to NTP steps.  The paired wall-clock read is recorded as a
+# CLOCK_ANCHOR event so external tools can align per-host files to
+# NTP-grade accuracy (the trace clock-sync protocol does better).
+MONO_ANCHOR_NS = time.monotonic_ns()
+WALL_ANCHOR_NS = time.time_ns()
+
 # Live timelines by path: an elastic reset tears the engine down and
 # re-initializes it in the SAME process, and the new engine must append
 # to the trace instead of truncating it — the reset/re-form cycle being
@@ -100,12 +110,18 @@ class Timeline:
         # "w" would erase the pre-reset history.
         self._f = open(filename, "a" if persistent else "w")
         self._f.write("[\n")
-        self._start_ns = time.monotonic_ns()
+        # One shared monotonic base per process (not per initialize):
+        # an elastic re-init appends to the same file, and its events
+        # must stay on the first incarnation's time axis.  The format
+        # (relative-µs ``ts``) is byte-compatible with existing parsers.
+        self._start_ns = MONO_ANCHOR_NS
         self._mark_cycles = mark_cycles
         self._q = queue.SimpleQueue()
         self._writer = threading.Thread(
             target=self._drain, name="hvd-timeline", daemon=True)
         self._writer.start()
+        self.instant("CLOCK_ANCHOR", mono_ns=MONO_ANCHOR_NS,
+                     wall_ns=WALL_ANCHOR_NS)
 
     def shutdown(self) -> None:
         if not self.enabled:

@@ -66,6 +66,7 @@ from typing import Dict, Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.telemetry import registry as _tmx
+from horovod_tpu.telemetry import trace as _trace
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import socketutil as su
 
@@ -704,6 +705,11 @@ def build_transports(rank: int, size: int, data: Dict[int, socket.socket],
             else:
                 kv.put(ack_key, "ok")
                 transports[r] = shm_factory(sock, seg, False, r)
+    if _trace.active():
+        # Record the selected medium per peer so merged traces can
+        # attribute hop latencies to the transport that carried them.
+        for r, t in sorted(transports.items()):
+            _trace.emit_instant("transport.map", peer=r, tp=t.kind)
     return transports
 
 

@@ -54,3 +54,52 @@ def test_tail_fallback_when_parsed_missing(tmp_path):
 def test_single_snapshot_is_a_pass(tmp_path):
     _write(tmp_path, 1, {"x_per_sec": 100.0})
     assert cbr.check(root=tmp_path) == []
+
+
+_PB_OLD = {"hop.recv": 1.2, "hop.reduce": 0.3, "hop.send_wait": 0.1,
+           "pack": 0.5, "unpack": 0.4}
+_PB_NEW = {"hop.recv": 4.2, "hop.reduce": 0.31, "hop.send_wait": 0.6,
+           "pack": 0.5, "unpack": 0.45}
+
+
+def test_phase_breakdown_deltas_name_the_moved_phase(tmp_path, capsys):
+    _write(tmp_path, 1, {"x_per_sec": 100.0, "phase_breakdown": _PB_OLD})
+    _write(tmp_path, 2, {"x_per_sec": 70.0, "phase_breakdown": _PB_NEW})
+    problems = cbr.check(root=tmp_path)
+    out = capsys.readouterr().out
+    # Throughput still gates; the phase diff rides along as attribution.
+    assert len(problems) == 1 and "x_per_sec" in problems[0]
+    assert "phase deltas" in out
+    lines = [ln for ln in out.splitlines() if "->" in ln and " ms)" in ln]
+    assert len(lines) == 3                       # top-3 only
+    assert "hop.recv" in lines[0]                # biggest mover first
+    assert "+3.0000 ms" in lines[0]
+    assert "hop.send_wait" in lines[1]
+
+
+def test_phase_deltas_printed_even_when_gate_passes(tmp_path, capsys):
+    _write(tmp_path, 1, {"x_per_sec": 100.0, "phase_breakdown": _PB_OLD})
+    _write(tmp_path, 2, {"x_per_sec": 99.0, "phase_breakdown": _PB_NEW})
+    assert cbr.check(root=tmp_path) == []
+    assert "phase deltas" in capsys.readouterr().out
+
+
+def test_phase_deltas_skipped_when_one_side_missing(tmp_path, capsys):
+    _write(tmp_path, 1, {"x_per_sec": 100.0})
+    _write(tmp_path, 2, {"x_per_sec": 99.0, "phase_breakdown": _PB_NEW})
+    assert cbr.check(root=tmp_path) == []
+    assert "phase deltas" not in capsys.readouterr().out
+
+
+def test_phase_breakdown_not_mistaken_for_a_metric(tmp_path):
+    # The nested dict must not leak into the numeric *_per_sec gate.
+    _write(tmp_path, 1, {"x_per_sec": 100.0, "phase_breakdown": _PB_OLD})
+    assert cbr.load_metrics(tmp_path / "BENCH_r01.json") == {
+        "x_per_sec": 100.0}
+    assert cbr.load_phase_breakdown(tmp_path / "BENCH_r01.json") == _PB_OLD
+
+
+def test_phase_deltas_handle_new_and_removed_phases():
+    rows = cbr.phase_deltas({"pack": 1.0}, {"unpack": 2.0}, top=3)
+    assert rows[0] == ("unpack", 0.0, 2.0, 2.0)
+    assert rows[1] == ("pack", 1.0, 0.0, -1.0)

@@ -13,6 +13,8 @@ import time
 
 import pytest
 
+import tracing_util
+
 from horovod_tpu.runner.http_server import RendezvousServer
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -423,12 +425,9 @@ def test_timeline(tmp_path, engine):
     assert "CYCLE_START" in content
     # valid JSON events even with a quote/backslash tensor name in the
     # job.  The Python engine writes a closing "{}]" footer on clean
-    # shutdown; the native writer leaves the array open — accept both.
-    stripped = content.rstrip()
-    if stripped.endswith("]"):
-        events = json.loads(stripped)
-    else:
-        events = json.loads(stripped.rstrip(",") + "]")
+    # shutdown; the native writer leaves the array open — the shared
+    # parser (tests/tracing_util.py) accepts both.
+    events = tracing_util.parse_timeline(content)
     assert len(events) > 0
     # both engines label lanes; the hostile name must appear escaped in
     # thread_name metadata without breaking the parse

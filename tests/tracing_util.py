@@ -1,0 +1,44 @@
+"""Shared parsing helpers for timeline / trace test assertions.
+
+The rank-0 timeline (horovod_tpu/utils/timeline.py) streams a Chrome
+``[`` + ``{event},`` lines file.  Two on-disk tail states are valid:
+
+* clean shutdown (Python engine, non-persistent): a ``{}]`` footer
+  closes the array — the file is already valid JSON;
+* open tail (native writer, persistent/elastic timelines, or a crash):
+  the array never closes, and the last line may even be a torn,
+  half-written record.
+
+Tests previously inlined the accept-both parse; it lives here so the
+timeline tests and the gang-trace tests (tests/test_trace.py) share one
+audited implementation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+
+def parse_timeline(content: str) -> List[dict]:
+    """Parse a Chrome-tracing timeline in either tail state.
+
+    A torn final record (crash mid-write) is dropped line-by-line until
+    the remainder parses, so every intact event is still returned."""
+    stripped = content.rstrip()
+    if stripped.endswith("]"):
+        return json.loads(stripped)
+    while True:
+        try:
+            return json.loads(stripped.rstrip().rstrip(",") + "]")
+        except ValueError:
+            # Torn tail: drop the last (partial) line and retry.
+            cut = stripped.rstrip().rfind("\n")
+            if cut < 0:
+                raise
+            stripped = stripped[:cut]
+
+
+def parse_timeline_file(path: str) -> List[dict]:
+    with open(path) as fh:
+        return parse_timeline(fh.read())

@@ -11,6 +11,13 @@ Metrics present in one round but not the other are reported as info and
 ignored: benchmarks grow with the repo and a new metric has no baseline
 yet, while a removed one has nothing to compare against.
 
+When both snapshots carry a ``phase_breakdown`` block (the gang-trace
+attribution bench.py embeds — mean ms per collective per rank, from
+tools/hvd_trace.py), the top phase deltas are printed alongside the
+gate so a tripped regression comes with the phase that moved, not just
+the throughput number (docs/timeline.md "Gang-wide tracing").  The
+phase diff is informational: only ``*_per_sec`` metrics gate.
+
 Usage: ``python tools/check_bench_regression.py [--tolerance 0.2]``
 (exit 1 on regression, 0 otherwise — including when fewer than two
 snapshots exist, since there is nothing to compare).  Wired into the
@@ -60,6 +67,31 @@ def load_metrics(path: Path) -> Dict[str, float]:
             if isinstance(v, (int, float)) and not isinstance(v, bool)}
 
 
+def load_phase_breakdown(path: Path) -> Dict[str, float]:
+    """The snapshot's ``phase_breakdown`` block (ms per collective per
+    rank, see tools/hvd_trace.py), or {} when the round predates gang
+    tracing or the traced bench run failed."""
+    doc = json.loads(path.read_text())
+    parsed = doc.get("parsed")
+    block = parsed.get("phase_breakdown") if isinstance(parsed, dict) else None
+    if not isinstance(block, dict):
+        return {}
+    return {k: float(v) for k, v in block.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+def phase_deltas(old: Dict[str, float], new: Dict[str, float],
+                 top: int = 3) -> List[Tuple[str, float, float, float]]:
+    """Top phase deltas as (phase, old_ms, new_ms, delta_ms), largest
+    absolute movement first.  Mirrors ``hvd_trace.top_deltas`` so the
+    lint stays import-free of the trace CLI."""
+    rows = [(k, old.get(k, 0.0), new.get(k, 0.0))
+            for k in sorted(set(old) | set(new))]
+    rows = [(k, o, n, n - o) for k, o, n in rows]
+    rows.sort(key=lambda r: abs(r[3]), reverse=True)
+    return rows[:top]
+
+
 def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
     """Return regression messages (empty = pass or nothing to compare)."""
     files = bench_files(root)
@@ -88,6 +120,12 @@ def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
                 f"{k} dropped {(1.0 - ratio) * 100:.1f}% "
                 f"(r{old_n}={old[k]:g} -> r{new_n}={new[k]:g}, "
                 f"tolerance {tolerance * 100:.0f}%)")
+    old_pb, new_pb = load_phase_breakdown(old_p), load_phase_breakdown(new_p)
+    if old_pb and new_pb:
+        print(f"  phase deltas r{old_n} -> r{new_n} "
+              "(ms per collective per rank):")
+        for phase, o, n, d in phase_deltas(old_pb, new_pb):
+            print(f"    {phase}: {o:.4f} -> {n:.4f} ({d:+.4f} ms)")
     return problems
 
 
