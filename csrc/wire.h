@@ -142,6 +142,16 @@ constexpr size_t kDataTrailerBytes = 8;
 constexpr uint8_t kTagClockPing = 14;
 constexpr uint8_t kTagClockPong = 15;
 
+// Control tags 16-17 are reserved by the Python engine's always-on
+// flight recorder (telemetry/blackbox.py): kTagBlackbox = 16 (u32
+// epoch; coordinator asks a live worker for its ring) and
+// kTagBlackboxDump = 17 (i32 rank, u32 epoch, u32 len, len bytes of
+// UTF-8 JSON — the same document blackbox_rank<r>.json would hold).
+// Like the abort tags these frames never reach a native engine; the
+// coordinator simply gets no dump from one.
+constexpr uint8_t kTagBlackbox = 16;
+constexpr uint8_t kTagBlackboxDump = 17;
+
 // CRC-32 (zlib polynomial), seed 0 — matches Python's zlib.crc32.
 uint32_t WireCrc32(const uint8_t* data, size_t len, uint32_t crc = 0);
 

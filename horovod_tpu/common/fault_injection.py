@@ -93,6 +93,8 @@ KNOWN_SITES = {
     "shm.attach": "shm segment attach during transport pairing",
     "trace.emit": "trace span-file write (a dropped/failed write must "
                   "never affect training)",
+    "blackbox.dump": "flight-recorder dump at a terminal failure (a "
+                     "failed dump must never mask the original error)",
     "train.step": "user-level per-step site (training scripts)",
     "serve.admit": "serving front-door admission (HTTP 503 shedding)",
     "serve.step": "serving decode step, every rank (stall/delay sim)",

@@ -82,6 +82,19 @@ from its ctrl recv thread with its own monotonic read, and the worker
 computes ``offset = t_coord − (t0 + t1)/2`` at receive time ``t1`` —
 the NTP midpoint method, accurate to half the control-channel RTT.
 
+Flight-recorder dump pull (Python engine only — ridden on
+``TAG_BLACKBOX`` / ``TAG_BLACKBOX_DUMP``, reserved as tags 16/17 in
+csrc/wire.h; after an abort verdict the coordinator pulls each live
+worker's in-memory ring so one archive survives a dead disk —
+docs/fault_tolerance.md "the black box"):
+
+  BlackboxRequest := u32 epoch
+  BlackboxDump    := i32 rank, u32 epoch, u32 len, bytes blob[len]
+
+The blob is the UTF-8 JSON dump document (``telemetry/blackbox.py``
+schema ``hvd-blackbox-v1``), byte-identical to what the worker would
+write to its own ``blackbox_rank<r>.json``.
+
 Recovery-ladder framing (``HVD_WIRE_CRC=1`` only — docs/fault_tolerance.md
 "recovery ladder"; tag numbers 11-13 and the trailer layout are reserved
 in csrc/wire.h, which the native engine must mirror before it can join a
@@ -445,6 +458,33 @@ def encode_clock_pong(t0_ns: int, t_coord_ns: int,
 def decode_clock_pong(data: bytes) -> Tuple[int, int, int]:
     t0_ns, t_coord_ns, epoch = struct.unpack_from("<qqI", data, 0)
     return t0_ns, t_coord_ns, epoch
+
+
+# -- flight-recorder dump pull (docs/fault_tolerance.md "black box") ----
+
+
+def encode_blackbox_request(epoch: int = 0) -> bytes:
+    """Coordinator -> worker (TAG_BLACKBOX): send me your flight-recorder
+    ring.  Sent after an abort-verdict broadcast so the archive on the
+    coordinator's disk covers ranks whose own dump may never land."""
+    return struct.pack("<I", epoch)
+
+
+def decode_blackbox_request(data: bytes) -> int:
+    (epoch,) = struct.unpack_from("<I", data, 0)
+    return epoch
+
+
+def encode_blackbox_dump(rank: int, epoch: int, blob: bytes) -> bytes:
+    """Worker -> coordinator (TAG_BLACKBOX_DUMP): the serialized dump
+    document (UTF-8 JSON, the same bytes ``blackbox_rank<r>.json`` would
+    hold)."""
+    return struct.pack("<iII", rank, epoch, len(blob)) + blob
+
+
+def decode_blackbox_dump(data: bytes) -> Tuple[int, int, bytes]:
+    rank, epoch, n = struct.unpack_from("<iII", data, 0)
+    return rank, epoch, bytes(data[12:12 + n])
 
 
 # -- recovery-ladder framing (docs/fault_tolerance.md) ------------------

@@ -59,12 +59,21 @@ from horovod_tpu.elastic.driver import (
     HostDiscoveryScript,
     HostsUpdatedInterrupt,
 )
+from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.telemetry import trace as _trace
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
 
 _ASSIGN_TIMEOUT_S = 600.0
+
+
+def _postmortem_suffix() -> str:
+    """Pointer appended to terminal elastic errors: where the flight-
+    recorder dumps landed, ready for tools/hvd_postmortem.py."""
+    if not env_util.blackbox_enabled():
+        return ""
+    return f"; postmortem: {env_util.blackbox_dir()}"
 
 
 def _worker_uid() -> str:
@@ -207,6 +216,11 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     t_reform0 = time.monotonic_ns()
     if 0 in failed:
         _tmx.inc_counter("hvd_leader_failovers_total")
+        # Leader failover is a terminal event for the old incarnation:
+        # dump before teardown so the evidence names the dead hub.
+        _bb.note("leader.failover", t_reform0, failed=sorted(failed),
+                 epoch=ctx.epoch)
+        _bb.dump("leader_failover", f"failed={sorted(failed)}")
     _timeline_event("ELASTIC_RESET", failed=sorted(failed))
     ctx.stop_driver()
     basics.shutdown()
@@ -217,7 +231,8 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     if ctx.uid not in survivors:
         raise RuntimeError(
             "this rank was evicted from the gang; cannot re-join the "
-            "same incarnation (restart the process to re-join)")
+            "same incarnation (restart the process to re-join)"
+            + _postmortem_suffix())
 
     if survivors and survivors[0] == ctx.uid:
         # Leader: lowest surviving old rank.  Admit pending joiners up
@@ -232,7 +247,7 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
             raise RuntimeError(
                 f"only {len(world)} worker(s) left after failure of "
                 f"rank(s) {sorted(failed)}, below --min-np={ctx.min_np}; "
-                f"exiting for a full relaunch")
+                f"exiting for a full relaunch" + _postmortem_suffix())
         ctx.kv.put(ctx.key(f"elastic/world/{new_epoch}"), json.dumps(world))
         ctx.kv.put(ctx.key("elastic/epoch"), str(new_epoch))
         for i, uid in enumerate(world):
@@ -250,7 +265,8 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
         if len(world) < ctx.min_np:
             raise RuntimeError(
                 f"re-formed world of {len(world)} is below "
-                f"--min-np={ctx.min_np}; exiting for a full relaunch")
+                f"--min-np={ctx.min_np}; exiting for a full relaunch"
+                + _postmortem_suffix())
 
     new_rank = world.index(ctx.uid)
     _set_world_env(new_rank, len(world), new_epoch)
@@ -261,6 +277,10 @@ def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     ctx.consume_updates()
     ctx.maybe_start_driver()
     _tmx.inc_counter("hvd_elastic_reforms_total")
+    # Epoch change on the flight recorder (the re-formed engine's
+    # from_env restamped rank/epoch on the surviving ring).
+    _bb.note("elastic.reform", t_reform0, epoch=new_epoch,
+             size=len(world), failed=sorted(failed))
     if 0 in failed:
         # The gang's hub died and the lowest surviving old rank was
         # elected leader by the world protocol above.  Recorded after

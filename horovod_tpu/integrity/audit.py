@@ -40,6 +40,7 @@ import numpy as np
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.types import ReplicaDivergenceError
+from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils import timeline as timeline_mod
 
@@ -130,6 +131,11 @@ def audit_replicas(tree, name: str = "integrity.audit") -> int:
     timeline_mod.engine_event(
         timeline_mod.DIVERGENCE_DETECTED, ranks=deviants,
         leaf=leaf_path, digests=digests)
+    # Terminal event: dump the flight recorder before the raise so the
+    # postmortem names the deviant rank(s) with the leaf that diverged.
+    _bb.note("replica.divergence", 0, ranks=deviants, leaf=leaf_path)
+    _bb.dump("replica_divergence",
+             f"deviants={deviants} leaf={leaf_path}")
     raise ReplicaDivergenceError(deviants, leaf_path, digests)
 
 

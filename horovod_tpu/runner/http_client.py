@@ -35,6 +35,7 @@ from typing import List, Optional, Tuple
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.retry import retry_call
 from horovod_tpu.runner import secret as secret_mod
+from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 
@@ -43,6 +44,8 @@ def _count_retry(attempt_index: int, exc: BaseException) -> None:
     # Invoked by retry_call before each backoff sleep; a no-op load +
     # None check when telemetry is off.
     _tmx.inc_counter("hvd_kv_retries_total")
+    _bb.note("kv.retry", 0, attempt=int(attempt_index),
+             error=type(exc).__name__)
 
 
 def _retryable(e: BaseException) -> bool:

@@ -108,9 +108,18 @@ def _stream(proc: subprocess.Popen, rank: int, out,
 class LaunchError(RuntimeError):
     def __init__(self, rank: int, returncode: int,
                  hostname: Optional[str] = None):
+        from horovod_tpu.utils import env as env_util
+
+        # Point the operator straight at the evidence: every rank's
+        # flight recorder dumped into HVD_BLACKBOX_DIR on the way down
+        # (telemetry/blackbox.py) — tools/hvd_postmortem.py names the
+        # first cause from there.
+        postmortem = (f"; postmortem: {env_util.blackbox_dir()}"
+                      if env_util.blackbox_enabled() else "")
         super().__init__(
             f"worker rank {rank} exited with code {returncode}"
-            + (f" on host {hostname}" if hostname else ""))
+            + (f" on host {hostname}" if hostname else "")
+            + postmortem)
         self.rank = rank
         self.returncode = returncode
         self.hostname = hostname
@@ -286,9 +295,12 @@ def launch_workers_elastic(
                                                 hostname=slot.hostname)
                 if on_failure is not None:
                     on_failure(slot.hostname)
+                from horovod_tpu.utils import env as env_util
+                pm = (f"; postmortem: {env_util.blackbox_dir()}"
+                      if env_util.blackbox_enabled() else "")
                 print(f"hvdrun: worker rank {slot.rank} on "
                       f"{slot.hostname} exited with code {rc}; the gang "
-                      "re-forms in process (elastic mode)",
+                      f"re-forms in process (elastic mode){pm}",
                       file=sys.stderr)
         originals_done = all(e["rc"] is not None for e in entries
                              if not e["joiner"])

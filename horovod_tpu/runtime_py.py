@@ -57,6 +57,7 @@ from horovod_tpu.common.types import (
 )
 from horovod_tpu.common.types import dtype_from_numpy, dtype_to_numpy_name
 from horovod_tpu import telemetry as _telemetry
+from horovod_tpu.telemetry import blackbox as blackbox_mod
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.telemetry import trace as trace_mod
 from horovod_tpu.utils import env as env_util
@@ -423,6 +424,17 @@ class PyEngine(_EngineBase):
             # The coordinator defines the gang clock axis: offset 0.
             self._tracer.clock(0, 0)
 
+        # Always-on flight recorder (telemetry/blackbox.py;
+        # docs/fault_tolerance.md "the black box").  Process-global so
+        # the ring survives elastic engine teardown; every terminal
+        # failure path below calls dump() before raising/propagating.
+        self._blackbox = blackbox_mod.from_env(rank, epoch=self.epoch)
+        self._blackbox_seq = 0
+        if self._blackbox is not None:
+            self._blackbox.note("engine.init", 0,
+                                {"rank": rank, "size": size,
+                                 "epoch": self.epoch})
+
         # request queue (tensor queue) + tensor table
         self._queue_lock = threading.Lock()
         self._request_queue: List[Request] = []
@@ -506,6 +518,11 @@ class PyEngine(_EngineBase):
         # Coordinator: last ruled verdict, re-sent to stragglers whose
         # own hop deadline fires after the broadcast.
         self._last_verdict: Optional[tuple] = None
+        # Coordinator: flight-recorder dumps pulled from live workers
+        # after an abort verdict (TAG_BLACKBOX_DUMP frames, captured by
+        # the ctrl recv threads).
+        self._blackbox_inbox: List[tuple] = []
+        self._blackbox_lock = threading.Lock()
 
         # response cache (parity: response_cache.cc; protocol adapted to
         # the star controller — see common/response_cache.py docstring).
@@ -636,6 +653,11 @@ class PyEngine(_EngineBase):
                             su.send_frame(sock, su.TAG_CLOCK_PONG, pong)
                     except (ConnectionError, OSError):
                         pass  # liveness machinery owns the eviction
+                elif tag == su.TAG_BLACKBOX_DUMP:
+                    # A worker's flight-recorder ring, answering our
+                    # post-verdict pull (_pull_blackbox_dumps).
+                    with self._blackbox_lock:
+                        self._blackbox_inbox.append((peer_rank, payload))
         except (ConnectionError, OSError):
             # EOF/reset: fast liveness signal, stronger than a missed
             # heartbeat (only acted on when heartbeats are enabled).
@@ -683,9 +705,29 @@ class PyEngine(_EngineBase):
                     if tr is not None and pepoch == self.epoch:
                         offset_ns = tc_ns - (t0_ns + t1_ns) // 2
                         tr.clock(offset_ns, t1_ns - t0_ns)
+                        # The flight recorder rides the same estimate;
+                        # its dump ships the freshest value so the
+                        # postmortem can align rank timelines.
+                        blackbox_mod.note_clock_offset(offset_ns)
                         if self._metrics_on:
                             _tmx.set_gauge("hvd_trace_clock_skew_seconds",
                                            offset_ns / 1e9)
+                elif tag == su.TAG_BLACKBOX:
+                    # Coordinator pulling our flight-recorder ring after
+                    # an abort verdict.  Answered from THIS thread — the
+                    # background thread may be the wedged party, and its
+                    # evidence is exactly what the pull is for.
+                    bb = blackbox_mod.get()
+                    if bb is not None:
+                        blob = bb.dump_bytes("coordinator_pull")
+                        reply = wire.encode_blackbox_dump(
+                            self.rank, self.epoch, blob)
+                        try:
+                            with self._ctrl_send_lock:
+                                su.send_frame(self._ctrl_sock,
+                                              su.TAG_BLACKBOX_DUMP, reply)
+                        except (ConnectionError, OSError):
+                            pass
         except (ConnectionError, OSError):
             # Coordinator EOF/reset.  During a negotiated shutdown (or
             # after our own close) this is expected teardown noise;
@@ -1465,6 +1507,9 @@ class PyEngine(_EngineBase):
             if r not in self._conn_lost:
                 _tmx.inc_counter("hvd_heartbeat_misses_total")
             _tmx.inc_counter("hvd_evictions_total")
+            blackbox_mod.note("heartbeat.miss", time.monotonic_ns(),
+                              rank=r,
+                              conn_lost=bool(r in self._conn_lost))
             self._evicted_ranks.add(r)
             self._joined_ranks.add(r)
         for nm, lst in list(self._msg_table.entries.items()):
@@ -1497,6 +1542,8 @@ class PyEngine(_EngineBase):
             self.timeline.instant(
                 timeline_mod.STRAGGLER, rank=lag_rank,
                 skew_ms=round(skew_s * 1e3, 3), tensor=name)
+        blackbox_mod.note("straggler", 0, rank=lag_rank,
+                          skew_ms=round(skew_s * 1e3, 3), name=name)
 
     # -- collective-abort agreement (docs/fault_tolerance.md) ------------
     #
@@ -1639,7 +1686,60 @@ class PyEngine(_EngineBase):
             except (ConnectionError, OSError):
                 pass
         self._apply_abort_verdict(name, wedged, t0)
+        # Archive the evidence: pull every live rank's flight-recorder
+        # ring (INCLUDING the wedged ones — their ctrl recv thread stays
+        # responsive while the background thread hangs in the data
+        # plane) so one dump directory survives even when a rank's own
+        # disk write never lands.
+        self._pull_blackbox_dumps(live)
         return wedged
+
+    def _pull_blackbox_dumps(self, ranks: List[int],
+                             wait_s: float = 1.0) -> None:
+        """Coordinator: request TAG_BLACKBOX dumps from ``ranks`` and
+        write whatever arrives within ``wait_s`` as
+        ``blackbox_rank<r>.pulled.json`` in our own HVD_BLACKBOX_DIR.
+        Best-effort evidence collection — never raises."""
+        bb = blackbox_mod.get()
+        if bb is None or not ranks:
+            return
+        req = wire.encode_blackbox_request(self.epoch)
+        asked = []
+        for r in ranks:
+            sock = self._ctrl_socks.get(r)
+            if sock is None:
+                continue
+            try:
+                with self._ctrl_send_lock:
+                    su.send_frame(sock, su.TAG_BLACKBOX, req)
+                asked.append(r)
+            except (ConnectionError, OSError):
+                pass
+        got: set = set()
+        deadline = time.monotonic() + wait_s
+        while len(got) < len(asked) and time.monotonic() < deadline:
+            with self._blackbox_lock:
+                inbox, self._blackbox_inbox = self._blackbox_inbox, []
+            for peer, payload in inbox:
+                try:
+                    drank, depoch, blob = wire.decode_blackbox_dump(
+                        payload)
+                    os.makedirs(bb.dir, exist_ok=True)
+                    path = os.path.join(
+                        bb.dir, f"blackbox_rank{drank}.pulled.json")
+                    tmp = f"{path}.tmp.{os.getpid()}"
+                    with open(tmp, "wb") as fh:
+                        fh.write(blob)
+                    os.replace(tmp, path)
+                    got.add(peer)
+                except Exception:
+                    got.add(peer)
+            if len(got) < len(asked):
+                time.sleep(0.02)
+        if asked:
+            self.log.info(
+                "flight-recorder archive: pulled %d/%d worker dumps "
+                "into %s", len(got), len(asked), bb.dir)
 
     def _report_and_await_verdict(self, name: str,
                                   suspect: int) -> Optional[List[int]]:
@@ -1693,6 +1793,13 @@ class PyEngine(_EngineBase):
             "gang verdict: rank(s) %s wedged during %r; aborting the "
             "collective (%.0f ms after the local timeout)", ranks, name,
             elapsed * 1e3)
+        # Terminal event: record the verdict and dump the flight
+        # recorder (failure path — the clock read here is free).
+        blackbox_mod.note("abort.verdict", time.monotonic_ns(),
+                          ranks=list(ranks), name=name,
+                          abort_ms=round(elapsed * 1e3, 3))
+        blackbox_mod.dump("collective_timeout",
+                          f"wedged={list(ranks)} name={name}")
         self._evicted_ranks.update(ranks)
         self._ranks_failed = sorted(set(self._ranks_failed) | set(ranks))
         if self.rank == 0 and self._msg_table is not None:
@@ -1729,6 +1836,12 @@ class PyEngine(_EngineBase):
         build the typed failure status every survivor shares."""
         name = resp.tensor_names[0]
         suspect = int(getattr(hop, "peer", -1))
+        # Blame record: who THIS rank was blocked on when its deadline
+        # fired — the postmortem triangulates the first cause from the
+        # gang's blame edges (failure path; clock read is free).
+        blackbox_mod.note("collective.timeout", time.monotonic_ns(),
+                          name=name, peer=suspect,
+                          phase=str(getattr(hop, "phase", "recv")))
         if self.rank == 0:
             wedged = self._coordinate_abort(name, {0: suspect})
         else:
@@ -1997,10 +2110,14 @@ class PyEngine(_EngineBase):
 
         if resp.response_type == ResponseType.EVICT:
             ranks = [int(x) for x in resp.tensor_sizes]
+            blackbox_mod.note("evict", time.monotonic_ns(),
+                              ranks=ranks)
             if self.rank in ranks:
                 # The coordinator declared *us* dead (e.g. a long GC
                 # pause): the group has moved on without this rank, so
                 # rejoining is impossible — stop before desyncing it.
+                blackbox_mod.dump("evicted",
+                                  "declared dead by the coordinator")
                 raise RuntimeError(
                     "evicted by the coordinator (missed heartbeats)")
             self._evicted_ranks.update(ranks)
@@ -2009,6 +2126,7 @@ class PyEngine(_EngineBase):
             self.log.error(
                 "rank(s) %s evicted; completing in-flight collectives "
                 "on the survivors", ranks)
+            blackbox_mod.dump("ranks_failed", f"evicted={ranks}")
             return
 
         if resp.response_type == ResponseType.ERROR:
@@ -2057,6 +2175,23 @@ class PyEngine(_EngineBase):
             # how long) even while this thread is blocked in the ring.
             self._in_collective_name = resp.tensor_names[0]
             self._in_collective_since = time.monotonic()
+        bb = self._blackbox
+        if bb is not None:
+            # Flight-recorder begin record: O(1) append, reusing a
+            # timestamp an enabled layer already took (tracer read or
+            # deadline marker) — never a fresh clock read.
+            self._blackbox_seq += 1
+            bb_t0 = (t_exec0 if tracer is not None
+                     else int(self._in_collective_since * 1e9)
+                     if deadline_on else 0)
+            peer = (self.rank - 1) % self.size if self.size > 1 else -1
+            tp = getattr(self._transports.get(peer), "kind", "")
+            bb.collective_begin(
+                bb_t0, self._blackbox_seq, resp.tensor_names[0],
+                op_name,
+                sum(getattr(e.array, "nbytes", 0) or 0
+                    for e in entries),
+                peer, tp)
         try:
             if resp.response_type == ResponseType.ALLREDUCE:
                 results = cpu_backend.allreduce(self, entries, resp)
@@ -2091,18 +2226,29 @@ class PyEngine(_EngineBase):
             # bottom rung is the exact PR-6 gang-wide abort/evict/replay
             # a hop deadline takes (docs/fault_tolerance.md).
             results = [None] * len(entries)
+            blackbox_mod.note("wire.corruption", time.monotonic_ns(),
+                              peer=int(getattr(e, "peer", -1)),
+                              cause=str(getattr(e, "cause", "")))
             if deadline_on:
                 self._in_collective_since = 0.0
                 status = self._collective_abort(resp, entries, e)
             else:
                 self.log.error("collective %s failed: %r", op_name, e)
                 status = Status.unknown_error(str(e))
+                blackbox_mod.dump("wire_corruption", str(e))
         except Exception as e:
             self.log.error("collective %s failed: %r", op_name, e)
             results = [None] * len(entries)
             status = Status.unknown_error(str(e))
         if deadline_on:
             self._in_collective_since = 0.0
+        if bb is not None:
+            # End record closes the in-flight marker.  Untimed on the
+            # happy path (no extra clock read when nothing fails); a
+            # failed collective may read the clock freely.
+            bb.collective_end(
+                0 if status.ok_() else time.monotonic_ns(),
+                self._blackbox_seq, status.ok_())
         self.timeline.end(resp.tensor_names[0])
         if tracer is not None:
             t_cb0 = time.monotonic_ns()
@@ -2128,4 +2274,5 @@ class PyEngine(_EngineBase):
         # Recorded for the elastic wrapper: a lost-coordinator abort on a
         # worker means rank 0 failed, which re-forms instead of exiting.
         self._abort_reason = reason
+        blackbox_mod.dump("engine_abort", reason)
         self._shutdown_flag.set()

@@ -53,6 +53,7 @@ from horovod_tpu.common.types import ReduceOp
 from horovod_tpu.serving.decode import DecodeEngine
 from horovod_tpu.serving.scheduler import Scheduler
 from horovod_tpu.serving.server import FrontDoor
+from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
 from horovod_tpu.utils.logging import get_logger
@@ -347,9 +348,17 @@ class ServingLoop:
                         step=seq, slots=len(self._slots))
             for slot in sorted(self._slots):
                 self._emit(slot, int(toks[slot]), engine, rank0)
+            # Step confirm on the flight recorder: reuses the tracer's
+            # post-confirm read when tracing, untimed otherwise (ring
+            # order still sequences it against failure events).
+            _bb.note("serve.confirm", tc0, step=seq,
+                     slots=len(self._slots))
             if rank0:
-                _tmx.observe("hvd_serve_token_latency_seconds",
-                             time.monotonic() - t0)
+                t1 = time.monotonic()
+                _tmx.observe("hvd_serve_token_latency_seconds", t1 - t0)
+                # Staleness surface for /stats last_step_age_s — the
+                # same clock read the latency observe just took.
+                self.scheduler.note_step(t1)
         if tr is not None:
             tr.span("serve.apply", ta0, time.monotonic_ns(), step=seq,
                     admitted=len(admissions))

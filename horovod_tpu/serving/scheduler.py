@@ -65,6 +65,12 @@ class Scheduler:
         self._ids = itertools.count()
         self._completed = 0
         self._recent: "OrderedDict[str, Request]" = OrderedDict()
+        # Monotonic stamp of the last gang-confirmed decode step, fed
+        # by the serving loop (loop.py reuses the latency read it
+        # already takes).  /stats derives last_step_age_s from it so an
+        # external router can spot a wedged gang before clients time
+        # out; 0.0 = no step confirmed yet this incarnation.
+        self._last_step_t = 0.0
 
     def _find(self, req_id: str) -> Optional[Request]:
         """A live or recently-completed request with this id, else None.
@@ -236,11 +242,30 @@ class Scheduler:
             return bool(self._queue) or \
                 any(r is not None for r in self._slots)
 
-    def stats(self) -> Dict[str, int]:
+    def note_step(self, t: float) -> None:
+        """The serving loop confirmed a decode step at monotonic time
+        ``t`` (a read the loop already took for its latency metric)."""
+        self._last_step_t = t
+
+    def stats(self) -> Dict[str, float]:
+        now = time.monotonic()
         with self._lock:
-            return {
+            oldest = min((r.t_submit for r in self._queue), default=now)
+            out = {
                 "queued": len(self._queue),
                 "active": sum(1 for r in self._slots if r is not None),
                 "slots": self.max_batch,
                 "completed": self._completed,
+                # Staleness surface for external probes: how long since
+                # the gang last stepped, and how long the oldest queued
+                # request has been starving.
+                "last_step_age_s": round(
+                    now - self._last_step_t, 3)
+                    if self._last_step_t else 0.0,
+                "oldest_queued_age_s": round(now - oldest, 3),
             }
+        _tmx.set_gauge("hvd_serve_last_step_age_seconds",
+                       out["last_step_age_s"])
+        _tmx.set_gauge("hvd_serve_oldest_queued_age_seconds",
+                       out["oldest_queued_age_s"])
+        return out

@@ -169,6 +169,16 @@ KNOWN_METRICS: Dict[str, dict] = {
     "hvd_serve_token_latency_seconds": _hist(
         "Wall time of one gang decode step (prefills + batched step + "
         "token-agreement allreduce).", *_SECONDS),
+    "hvd_serve_last_step_age_seconds": _gauge(
+        "Seconds since the gang last confirmed a decode step (rank 0; "
+        "refreshed on each /stats read — a growing value means the gang "
+        "is wedged)."),
+    "hvd_serve_oldest_queued_age_seconds": _gauge(
+        "Age of the oldest request still waiting for a decode slot "
+        "(rank 0; 0 when the queue is empty)."),
+    # -- flight recorder (telemetry/blackbox.py; docs/fault_tolerance.md) --
+    "hvd_blackbox_dumps_total": _counter(
+        "Flight-recorder dumps written at terminal failures."),
 }
 
 

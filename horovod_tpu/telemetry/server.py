@@ -66,6 +66,26 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(_reg.snapshot()).encode("utf-8")
             self._send(200, body, "application/json")
             return
+        if self.path == "/debug/blackbox":
+            # Live flight-recorder peek: the current ring as bounded
+            # JSON (the ring is capacity-capped, so the body is too) —
+            # a wedged-but-alive rank can be inspected without killing
+            # it.  The handler thread stays responsive even while the
+            # engine's background thread hangs in the data plane.
+            import json
+
+            from horovod_tpu.telemetry import blackbox as _bb
+
+            bb = _bb.get()
+            if bb is None:
+                self._send(404, b'{"error": "blackbox disabled"}',
+                           "application/json")
+                return
+            doc = bb.snapshot()
+            doc["role"] = "coordinator" if bb.rank == 0 else "worker"
+            body = json.dumps(doc).encode("utf-8")
+            self._send(200, body, "application/json")
+            return
         self._send(404, b"", "text/plain")
 
 

@@ -138,6 +138,15 @@ STRAGGLER_WARN_MS = "HVD_STRAGGLER_WARN_MS"
 TRACE = "HVD_TRACE"
 TRACE_DIR = "HVD_TRACE_DIR"
 TRACE_CLOCK_SYNC_CYCLES = "HVD_TRACE_CLOCK_SYNC_CYCLES"
+# Always-on flight recorder (telemetry/blackbox.py; docs/fault_tolerance.md
+# "the black box").  Unlike HVD_TRACE this is ON by default: every rank
+# keeps the last BLACKBOX_EVENTS events (default 512) in a fixed-capacity
+# in-memory ring and dumps ``blackbox_rank<r>.json`` into BLACKBOX_DIR on
+# any terminal failure, so the 3 a.m. crash ships its own evidence.
+# BLACKBOX=0 turns the recorder off entirely.
+BLACKBOX = "HVD_BLACKBOX"
+BLACKBOX_EVENTS = "HVD_BLACKBOX_EVENTS"
+BLACKBOX_DIR = "HVD_BLACKBOX_DIR"
 # Inference serving (horovod_tpu.serving; docs/serving.md).  PORT is the
 # rank-0 HTTP front door (0 = ephemeral); MAX_BATCH is the number of
 # continuous-batching decode slots; MAX_QUEUE bounds the admission queue
@@ -278,6 +287,22 @@ def trace_clock_sync_cycles() -> int:
     """Worker clock-ping cadence in background cycles (floor 1); the
     first ping goes out on the first cycle regardless."""
     return max(1, get_int(TRACE_CLOCK_SYNC_CYCLES, 200))
+
+
+def blackbox_enabled() -> bool:
+    """True unless HVD_BLACKBOX=0: the flight recorder is always-on."""
+    return get_bool(BLACKBOX, True)
+
+
+def blackbox_events() -> int:
+    """Ring capacity in events (floor 16 — a dump with fewer events than
+    one collective's worth of context is not evidence)."""
+    return max(16, get_int(BLACKBOX_EVENTS, 512))
+
+
+def blackbox_dir() -> str:
+    """Directory the per-rank ``blackbox_rank<r>.json`` dumps land in."""
+    return get_str(BLACKBOX_DIR, "hvd_blackbox") or "hvd_blackbox"
 
 
 def send_wait_cap_s() -> float:

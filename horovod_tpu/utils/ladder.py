@@ -75,6 +75,7 @@ from typing import Dict, Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common import wire
+from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.telemetry import trace as _trace
 from horovod_tpu.utils import env as env_util
@@ -324,6 +325,11 @@ class LadderLink(tpt.Transport):
         _tmx.inc_counter("hvd_hop_retries_total", 1.0, (cause,))
         _tl.engine_event(_tl.HOP_RETRY, peer=self.peer, cause=cause,
                          expected=int(expected), frames=len(frames))
+        # Rung climb on the flight recorder (untimed: a recovery rung is
+        # rare enough to sequence by ring order, and the recorder must
+        # not add clock reads the ladder doesn't already take).
+        _bb.note("ladder.retry", 0, peer=self.peer, cause=cause,
+                 frames=len(frames))
         t0 = time.monotonic_ns() if _trace.active() else 0
         for f in frames:
             if self._closing or self._poison is not None:
@@ -627,6 +633,7 @@ class LadderLink(tpt.Transport):
             self._snd_q.appendleft(("replay", int(pexp), "reset"))
             self._snd_cv.notify_all()
         _tmx.inc_counter("hvd_peer_reconnects_total")
+        _bb.note("ladder.reconnect", 0, peer=self.peer)
         return True
 
     # -- shm watcher / failover -------------------------------------------
@@ -689,6 +696,7 @@ class LadderLink(tpt.Transport):
         with self._rcv_cv:
             self._rcv_cv.notify_all()
         _tmx.inc_counter("hvd_transport_failovers_total")
+        _bb.note("ladder.failover", 0, peer=self.peer)
         _tl.engine_event(_tl.TRANSPORT_FAILOVER, peer=self.peer,
                          rank=self.rank)
         _trace.emit_instant("transport.failover", peer=self.peer, tp="tcp")

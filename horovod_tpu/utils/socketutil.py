@@ -64,6 +64,14 @@ TAG_FAILOVER = 13       # both ways on the mesh socket: shm->TCP demotion
 # values reserved in csrc/wire.h.
 TAG_CLOCK_PING = 14     # worker -> coordinator: my clock, now
 TAG_CLOCK_PONG = 15     # coordinator -> worker: echo + coord clock
+# Flight-recorder dump pull (Python engine only, always-on unless
+# HVD_BLACKBOX=0; telemetry/blackbox.py, docs/fault_tolerance.md "the
+# black box").  After broadcasting an abort verdict the coordinator
+# pulls each still-live worker's in-memory ring over the ctrl star, so
+# one archive survives even when a rank's disk doesn't.  Payload
+# codecs: common/wire.py; values reserved in csrc/wire.h.
+TAG_BLACKBOX = 16       # coordinator -> worker: send me your ring
+TAG_BLACKBOX_DUMP = 17  # worker -> coordinator: serialized ring dump
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:

@@ -1,0 +1,77 @@
+"""Flight-recorder gang scenario worker for tests/test_blackbox.py.
+
+A 3-rank elastic gang trains under ``HVD_COLLECTIVE_TIMEOUT`` with the
+always-on black box recording (docs/fault_tolerance.md "the black
+box").  The victim rank (``BLACKBOX_VICTIM=1``) fails at step 1 in one
+of two ways, picked by ``BLACKBOX_MODE``:
+
+* ``stall`` — wedge its own data-plane receive "forever" (GC-pause /
+  partition-style hang: the process stays alive, the control recv
+  thread keeps answering, so the coordinator can PULL its ring).
+* ``kill`` — ``os._exit(137)`` inside the ring hop, the SIGKILL-style
+  death mid-collective that leaves no dump at all.
+
+Either way the survivors must raise the typed gang abort naming the
+victim, dump their flight recorders on the way through it, re-form
+under ``@hvd.elastic.run``, and finish.  The driving test then checks
+the dump directory (survivor dumps + the coordinator-pulled archive)
+and runs tools/hvd_postmortem.py over it.
+
+Markers (``flush=True`` so the driver parses them even on abrupt
+death): ``STEP <i> <v>``, ``FAIL <type> ranks=<json>``, ``DONE``.
+"""
+
+import json
+import os
+
+import numpy as np
+
+TOTAL_STEPS = 3
+VICTIM_STEP = 1
+N = 8
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.common import fault_injection as fi
+    from horovod_tpu.common.types import RanksFailedError
+    from horovod_tpu.ops import eager
+
+    victim = os.environ.get("BLACKBOX_VICTIM") == "1"
+    mode = os.environ.get("BLACKBOX_MODE", "stall")
+
+    hvd.init()
+    state = hvd.elastic.ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < TOTAL_STEPS:
+            rank = hvd.rank()
+            if victim and state.step == VICTIM_STEP:
+                # Arm in-process right before the fused submit (no
+                # `after` counting against bootstrap collectives).
+                fault = ({"site": "sock.stall", "kind": "stall",
+                          "stall_s": 600} if mode == "stall" else
+                         {"site": "sock.stall", "kind": "kill"})
+                fi.configure({"faults": [fault]})
+            data = (np.arange(N, dtype=np.float32)
+                    + 10.0 * rank + 100.0 * state.step)
+            try:
+                out = eager.synchronize(eager.allreduce_async(
+                    data, op=hvd.Sum, name=f"grad.s{state.step}"))
+            except RanksFailedError as e:
+                print(f"FAIL {type(e).__name__} "
+                      f"ranks={json.dumps(sorted(e.ranks))}", flush=True)
+                raise  # the elastic wrapper owns evict-and-replay
+            print(f"STEP {state.step} {float(np.asarray(out)[0])}",
+                  flush=True)
+            state.step += 1
+            state.commit()
+
+    train(state)
+    print("DONE", flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

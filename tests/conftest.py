@@ -205,6 +205,17 @@ def _leak_sweep():
         "standby KV server, or shm segment)")
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _blackbox_scratch(tmp_path_factory):
+    # The flight recorder is always-on (HVD_BLACKBOX) and dumps on the
+    # terminal failures many gang tests deliberately trigger; point the
+    # whole session — and every spawned worker, via env inheritance —
+    # at a scratch dir so blackbox_rank*.json never lands in the repo
+    # root.  Tests that assert on dumps override the var per-worker.
+    os.environ.setdefault(
+        "HVD_BLACKBOX_DIR", str(tmp_path_factory.mktemp("blackbox")))
+
+
 @pytest.fixture(scope="session")
 def jax():
     import jax as _jax

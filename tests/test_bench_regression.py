@@ -1,6 +1,7 @@
-"""Bench-throughput regression gate as a test: the newest ``BENCH_r*``
-snapshot must not drop any shared ``*_per_sec`` metric by more than 20%
-vs the previous round (tools/check_bench_regression.py)."""
+"""Bench regression gate as a test: the newest ``BENCH_r*`` snapshot
+must not drop any shared ``*_per_sec`` metric — nor raise any shared
+``*_p99_ms`` / ``*_p50_ms`` latency percentile — by more than 20% vs
+the previous round (tools/check_bench_regression.py)."""
 
 import json
 import sys
@@ -30,9 +31,43 @@ def test_detects_throughput_drop(tmp_path):
     _write(tmp_path, 2, {"x_per_sec": 70.0, "lat_ms": 50.0})
     problems = cbr.check(root=tmp_path)
     assert len(problems) == 1 and "x_per_sec" in problems[0]
-    # Latency is not gated; within tolerance passes.
+    # Plain *_ms means stay informational (only percentiles gate);
+    # within tolerance passes.
     _write(tmp_path, 2, {"x_per_sec": 85.0, "lat_ms": 50.0})
     assert cbr.check(root=tmp_path) == []
+
+
+def test_detects_latency_percentile_rise(tmp_path):
+    _write(tmp_path, 1, {"serve_p99_ms": 10.0, "serve_p50_ms": 2.0})
+    _write(tmp_path, 2, {"serve_p99_ms": 15.0, "serve_p50_ms": 2.1})
+    problems = cbr.check(root=tmp_path)
+    assert len(problems) == 1, problems
+    assert "serve_p99_ms" in problems[0] and "rose 50.0%" in problems[0]
+
+
+def test_latency_within_tolerance_passes(tmp_path):
+    _write(tmp_path, 1, {"serve_p99_ms": 10.0, "serve_p50_ms": 2.0})
+    _write(tmp_path, 2, {"serve_p99_ms": 11.9, "serve_p50_ms": 1.2})
+    # +19% p99 is inside the 20% tolerance; a latency IMPROVEMENT of
+    # any size never trips the gate (it is one-sided, like throughput).
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_latency_gate_ignores_unshared_and_zero_baseline(tmp_path):
+    _write(tmp_path, 1, {"old_p99_ms": 10.0, "zero_p50_ms": 0.0})
+    _write(tmp_path, 2, {"new_p99_ms": 99.0, "zero_p50_ms": 5.0})
+    # new_p99_ms has no baseline, old_p99_ms no successor, and a zero
+    # baseline has no meaningful ratio — none of them gate.
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_latency_and_throughput_both_reported(tmp_path):
+    _write(tmp_path, 1, {"x_per_sec": 100.0, "serve_p50_ms": 4.0})
+    _write(tmp_path, 2, {"x_per_sec": 70.0, "serve_p50_ms": 8.0})
+    problems = cbr.check(root=tmp_path)
+    assert len(problems) == 2, problems
+    assert any("x_per_sec" in p for p in problems)
+    assert any("serve_p50_ms" in p for p in problems)
 
 
 def test_compares_newest_two_only_and_ignores_unshared(tmp_path):

@@ -1,0 +1,650 @@
+"""Always-on flight recorder + gang-wide postmortem
+(docs/fault_tolerance.md "the black box", docs/troubleshooting.md
+"Postmortem workflow").
+
+Layered like the subsystem:
+
+* recorder unit tests — bounded ring, env knobs, re-adoption across
+  elastic re-forms, atomic schema-stable dumps, secret redaction, the
+  ``blackbox.dump`` chaos site (a failed dump never masks the original
+  error), the no-extra-clock-reads and flat-allocation cost pins, and
+  the SIGTERM dump hook.
+* wire codecs — TAG_BLACKBOX / TAG_BLACKBOX_DUMP roundtrips and the
+  csrc tag reservation.
+* ``/debug/blackbox`` — the live-ring peek on the metrics debug server.
+* hvd_postmortem unit tests on synthetic dumps — gang-ruling quorum,
+  blame-edge fallback, clock-aligned earliest-silent, direct-over-
+  pulled preference, torn-file tolerance, SIGKILL reconstruction.
+* the acceptance gangs — a 3-rank gang with a chaos-stalled (or
+  chaos-killed) rank: survivors abort + dump, the coordinator pulls the
+  wedged rank's ring over the control channel, and hvd_postmortem.py
+  names exactly the victim as first cause with phase and peer.
+"""
+
+import gc
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import tracemalloc
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.common import wire
+from horovod_tpu.runner.http_server import RendezvousServer
+from horovod_tpu.telemetry import blackbox as bbm
+from horovod_tpu.utils import env as env_util
+from horovod_tpu.utils import socketutil as su
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import hvd_postmortem as pm  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "blackbox_worker.py")
+TOOL = os.path.join(REPO, "tools", "hvd_postmortem.py")
+
+TIMEOUT_S = 2.0  # HVD_COLLECTIVE_TIMEOUT for the gang scenarios
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    bbm.reset()
+    fi.clear()
+    yield
+    bbm.reset()
+    fi.clear()
+
+
+# ---------------------------------------------------------------------------
+# recorder: ring + knobs + lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_bounded_and_keeps_newest(tmp_path):
+    bb = bbm.Blackbox(0, 16, str(tmp_path))
+    for i in range(40):
+        bb.note(f"ev.{i}", i)
+    events = bb.snapshot()["events"]
+    assert len(events) == 16
+    assert events[0]["kind"] == "ev.24"   # oldest 24 recycled away
+    assert events[-1]["kind"] == "ev.39"
+    assert events[-1]["t_ns"] == 39
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.delenv(env_util.BLACKBOX, raising=False)
+    monkeypatch.delenv(env_util.BLACKBOX_EVENTS, raising=False)
+    assert env_util.blackbox_enabled() is True   # always-on default
+    assert env_util.blackbox_events() == 512
+    monkeypatch.setenv(env_util.BLACKBOX, "0")
+    assert env_util.blackbox_enabled() is False
+    monkeypatch.setenv(env_util.BLACKBOX_EVENTS, "4")
+    assert env_util.blackbox_events() == 16      # floor
+    monkeypatch.delenv(env_util.BLACKBOX_DIR, raising=False)
+    assert env_util.blackbox_dir() == "hvd_blackbox"
+
+
+def test_disabled_is_a_noop(monkeypatch, tmp_path):
+    monkeypatch.setenv(env_util.BLACKBOX, "0")
+    assert bbm.from_env(0) is None
+    assert bbm.get() is None and not bbm.active()
+    bbm.note("ev", 1, a=2)                       # global load + None check
+    assert bbm.dump("engine_abort", "x") is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_from_env_readopts_ring_across_reforms(monkeypatch, tmp_path):
+    monkeypatch.setenv(env_util.BLACKBOX_DIR, str(tmp_path))
+    bb = bbm.from_env(1, epoch=0)
+    bb.note("before.reform", 7)
+    # Elastic re-form: the engine is rebuilt but the evidence survives,
+    # restamped with the new coordinates.
+    bb2 = bbm.from_env(0, epoch=2)
+    assert bb2 is bb
+    assert bb2.rank == 0 and bb2.epoch == 2
+    kinds = [e["kind"] for e in bb2.snapshot()["events"]]
+    assert "before.reform" in kinds
+
+
+def test_in_flight_tracks_begin_end(tmp_path):
+    bb = bbm.Blackbox(1, 32, str(tmp_path))
+    bb.collective_begin(100, 3, "grad.s1", "Sum", 4096, 0, "tcp")
+    snap = bb.snapshot()
+    assert snap["in_flight"] == {"name": "grad.s1", "since_ns": 100}
+    ev = snap["events"][-1]
+    assert ev["kind"] == "collective.begin" and ev["seq"] == 3
+    assert ev["peer"] == 0 and ev["bytes"] == 4096 and ev["tp"] == "tcp"
+    bb.collective_end(0, 3, True)
+    snap = bb.snapshot()
+    assert snap["in_flight"] is None
+    assert snap["events"][-1] == {"kind": "collective.end", "t_ns": 0,
+                                  "seq": 3, "ok": True}
+
+
+# ---------------------------------------------------------------------------
+# recorder: dump
+# ---------------------------------------------------------------------------
+
+
+def test_dump_schema_and_atomicity(monkeypatch, tmp_path):
+    monkeypatch.setenv("HVD_RANK", "3")
+    bb = bbm.Blackbox(3, 32, str(tmp_path / "bb"))
+    bb.note("kv.retry", 0, {"attempt": 1, "error": "OSError"})
+    bb.collective_begin(50, 1, "grad.s1", "Sum", 64, 2, "shm")
+    bb.note_clock_offset(-123)
+    path = bb.dump("collective_timeout", "wedged=[2] name=grad.s1")
+    assert path == str(tmp_path / "bb" / "blackbox_rank3.json")
+    doc = json.loads(Path(path).read_text())
+    assert doc["schema"] == bbm.SCHEMA == "hvd-blackbox-v1"
+    assert doc["rank"] == 3 and doc["capacity"] == 32
+    assert doc["reason"] == "collective_timeout"
+    assert doc["detail"] == "wedged=[2] name=grad.s1"
+    assert doc["clock_offset_ns"] == -123
+    assert doc["in_flight"]["name"] == "grad.s1"
+    # Events are flattened: fields sit beside kind/t_ns at top level.
+    kinds = {e["kind"]: e for e in doc["events"]}
+    assert kinds["kv.retry"]["attempt"] == 1
+    assert kinds["collective.begin"]["peer"] == 2
+    assert doc["env"]["HVD_RANK"] == "3"        # fingerprint captured
+    # Atomic: no temp debris, and a second dump overwrites in place.
+    assert list((tmp_path / "bb").glob("*.tmp.*")) == []
+    assert bb.dump("engine_abort") == path
+    assert json.loads(Path(path).read_text())["reason"] == "engine_abort"
+
+
+def test_dump_redacts_secrets(monkeypatch, tmp_path):
+    monkeypatch.setenv("HVD_SECRET_KEY", "hunter2")
+    bb = bbm.Blackbox(0, 16, str(tmp_path))
+    path = bb.dump("sigterm")
+    text = Path(path).read_text()
+    assert "hunter2" not in text
+    assert json.loads(text)["env"]["HVD_SECRET_KEY"] == "<redacted>"
+
+
+def test_failed_dump_never_masks_the_original_error(tmp_path):
+    """The ``blackbox.dump`` chaos site: a full disk at dump time drops
+    the black box and the ORIGINAL failure keeps propagating."""
+    bb = bbm.Blackbox(0, 16, str(tmp_path / "bb"))
+    bb.note("wire.corruption", 0, {"peer": 1, "cause": "corrupt"})
+    fi.configure({"faults": [
+        {"site": "blackbox.dump", "kind": "error", "times": 1}]})
+    with pytest.raises(RuntimeError, match="the original failure"):
+        try:
+            raise RuntimeError("the original failure")
+        except RuntimeError:
+            assert bb.dump("wire_corruption", "peer 1") is None
+            raise
+    assert not (tmp_path / "bb").exists()       # nothing half-written
+    # Budget spent: the next terminal event dumps normally, ring intact.
+    path = bb.dump("wire_corruption", "peer 1")
+    assert path is not None
+    assert json.loads(Path(path).read_text())["events"][0]["peer"] == 1
+
+
+def test_dump_bytes_never_raises(tmp_path):
+    bb = bbm.Blackbox(2, 16, str(tmp_path))
+    bb.note("ev", 0, {"bad": float("nan")})     # not strict-JSON
+    blob = bb.dump_bytes("coordinator_pull")
+    doc = json.loads(blob)                      # degraded but valid
+    assert doc["schema"] == bbm.SCHEMA and doc["rank"] == 2
+
+
+# ---------------------------------------------------------------------------
+# recorder: cost pins
+# ---------------------------------------------------------------------------
+
+
+class _CountingTime:
+    """time-module proxy counting every clock read made by code that
+    resolves ``time`` through the patched module global (same harness
+    as test_trace's zero-cost pin)."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __getattr__(self, name):
+        return getattr(time, name)
+
+    def monotonic_ns(self):
+        self.calls += 1
+        return time.monotonic_ns()
+
+    def monotonic(self):
+        self.calls += 1
+        return time.monotonic()
+
+    def time_ns(self):
+        self.calls += 1
+        return time.time_ns()
+
+
+def test_recording_reads_no_clock(monkeypatch, tmp_path):
+    """note()/collective_begin()/collective_end() never read the clock —
+    call sites pass timestamps they already took (or 0).  Only the dump
+    path (terminal, cold) may."""
+    monkeypatch.setenv(env_util.BLACKBOX_DIR, str(tmp_path))
+    bb = bbm.from_env(0)
+    ct = _CountingTime()
+    monkeypatch.setattr(bbm, "time", ct)
+    for i in range(100):
+        bbm.note("ladder.retry", 0, peer=1, cause="corrupt")
+        bb.collective_begin(i, i, "t", "Sum", 8, 1, "tcp")
+        bb.collective_end(0, i, True)
+        bbm.note_clock_offset(i)
+    assert ct.calls == 0, \
+        f"recording hot path made {ct.calls} clock reads"
+    bb.dump("engine_abort")
+    assert ct.calls > 0                          # the cold path may
+
+
+def test_note_steady_state_allocations_flat(tmp_path):
+    """Once the ring is at capacity every append recycles an evicted
+    slot: net traced memory stays flat (the allocation side of the same
+    contract test_dataplane pins for the whole data plane)."""
+    bb = bbm.Blackbox(0, 64, str(tmp_path))
+    bbm._BB = bb
+    for i in range(200):                         # warmup: ring full
+        bbm.note("serve.confirm", 0, step=i, slots=8)
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.get_traced_memory()[0]
+    for i in range(1000):
+        bbm.note("serve.confirm", 0, step=i, slots=8)
+    after = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+    assert after - before < 16384, (before, after)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM hook
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout(60)
+def test_sigterm_dumps_then_dies_by_sigterm(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HVD_BLACKBOX_DIR"] = str(tmp_path)
+    code = (
+        "import os, signal\n"
+        "from horovod_tpu.telemetry import blackbox as bb\n"
+        "bb.from_env(5)\n"
+        "bb.note('engine.init', 0, rank=5)\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n")
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, timeout=50)
+    # The chained hook re-raises the default disposition after dumping.
+    assert res.returncode == -signal.SIGTERM, (res.returncode, res.stderr)
+    doc = json.loads((tmp_path / "blackbox_rank5.json").read_text())
+    assert doc["reason"] == "sigterm"
+    assert doc["events"][0]["kind"] == "engine.init"
+
+
+# ---------------------------------------------------------------------------
+# wire codecs + tag reservation
+# ---------------------------------------------------------------------------
+
+
+def test_wire_blackbox_codecs_roundtrip():
+    blob = wire.encode_blackbox_request(7)
+    assert wire.decode_blackbox_request(blob) == 7
+    assert wire.decode_blackbox_request(
+        wire.encode_blackbox_request()) == 0
+
+    payload = b'{"schema":"hvd-blackbox-v1","rank":2,"events":[]}'
+    frame = wire.encode_blackbox_dump(2, 3, payload)
+    assert wire.decode_blackbox_dump(frame) == (2, 3, payload)
+    rank, epoch, blob = wire.decode_blackbox_dump(
+        wire.encode_blackbox_dump(-1, 0, b""))
+    assert (rank, epoch, blob) == (-1, 0, b"")
+
+
+def test_ctrl_tags_reserved_everywhere():
+    assert su.TAG_BLACKBOX == 16
+    assert su.TAG_BLACKBOX_DUMP == 17
+    tags = [v for k, v in vars(su).items() if k.startswith("TAG_")]
+    assert len(tags) == len(set(tags)), "duplicate ctrl tag value"
+    header = Path(REPO, "csrc", "wire.h").read_text()
+    assert "kTagBlackbox = 16" in header
+    assert "kTagBlackboxDump = 17" in header
+
+
+# ---------------------------------------------------------------------------
+# /debug/blackbox
+# ---------------------------------------------------------------------------
+
+
+def test_debug_blackbox_endpoint(monkeypatch, tmp_path):
+    from horovod_tpu.telemetry.server import MetricsServer
+
+    monkeypatch.setenv(env_util.BLACKBOX_DIR, str(tmp_path))
+    bbm.from_env(0)
+    bbm.note("heartbeat.miss", 0, rank=2, conn_lost=True)
+    srv = MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/blackbox", timeout=5)
+        assert resp.headers["Content-Type"] == "application/json"
+        doc = json.load(resp)
+        assert doc["schema"] == bbm.SCHEMA
+        assert doc["role"] == "coordinator"      # rank 0
+        assert any(e["kind"] == "heartbeat.miss" for e in doc["events"])
+        # Disabled recorder -> 404, not a crash.
+        bbm.reset()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/blackbox", timeout=5)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# hvd_postmortem: synthetic-dump unit tests
+# ---------------------------------------------------------------------------
+
+
+def _write_dump(d, rank, events, reason="collective_timeout",
+                offset=0, pulled=False, in_flight=None):
+    doc = {"schema": "hvd-blackbox-v1", "rank": rank, "epoch": 0,
+           "capacity": 512, "clock_offset_ns": offset,
+           "in_flight": in_flight, "events": events, "reason": reason}
+    name = f"blackbox_rank{rank}{'.pulled' if pulled else ''}.json"
+    (Path(d) / name).write_text(json.dumps(doc))
+
+
+def test_postmortem_gang_ruling_wins(tmp_path):
+    verdict = {"kind": "abort.verdict", "t_ns": 900, "ranks": [2],
+               "name": "grad.s1", "abort_ms": 210.0}
+    blame = {"kind": "collective.timeout", "t_ns": 880, "name": "grad.s1",
+             "peer": 0, "phase": "recv"}         # blame edge points WRONG
+    _write_dump(tmp_path, 0, [verdict])
+    _write_dump(tmp_path, 1, [blame, verdict])
+    _write_dump(tmp_path, 2, [
+        {"kind": "collective.begin", "t_ns": 500, "seq": 7,
+         "name": "grad.s1", "op": "Sum", "bytes": 32, "peer": 1,
+         "tp": "tcp"}],
+        reason="coordinator_pull", pulled=True,
+        in_flight={"name": "grad.s1", "since_ns": 500})
+    v = pm.analyze(str(tmp_path))
+    assert v["first_cause"] == 2                 # ruling beats blame
+    assert v["gang_ruled"] == [2]
+    assert v["doing"]["name"] == "grad.s1"
+    assert v["doing"]["peer"] == 1 and v["doing"]["seq"] == 7
+    assert v["ranks"][2]["pulled"] is True
+    assert any("pulled over the control channel" in e
+               for e in v["evidence"])
+
+
+def test_postmortem_blame_edges_and_sigkill_reconstruction(tmp_path):
+    """No gang ruling and no dump from the culprit (SIGKILL): the
+    most-blamed peer is named and its context is rebuilt from the
+    survivors' blame edges."""
+    edge = {"kind": "collective.timeout", "t_ns": 10, "name": "grad.s1",
+            "peer": 2, "phase": "recv"}
+    _write_dump(tmp_path, 0, [edge])
+    _write_dump(tmp_path, 1, [edge])
+    v = pm.analyze(str(tmp_path))
+    assert v["first_cause"] == 2 and v["most_blamed"] == 2
+    assert 2 not in v["dumped_ranks"]
+    assert v["doing"]["name"] == "grad.s1"
+    assert v["doing"]["phase"] == "recv"
+    assert any("left no dump" in e for e in v["evidence"])
+
+
+def test_postmortem_earliest_silent_uses_clock_alignment(tmp_path):
+    # Raw t_ns would name rank 0 (100 < 850 < 900); rank 2's recorded
+    # offset re-anchors 850 to 50 on rank 0's axis — it went quiet first.
+    _write_dump(tmp_path, 0, [{"kind": "serve.confirm", "t_ns": 100}],
+                reason="engine_abort")
+    _write_dump(tmp_path, 1, [{"kind": "serve.confirm", "t_ns": 900}],
+                reason="engine_abort")
+    _write_dump(tmp_path, 2, [{"kind": "serve.confirm", "t_ns": 850}],
+                reason="engine_abort", offset=-800)
+    v = pm.analyze(str(tmp_path))
+    assert v["earliest_silent"] == 2
+    assert v["first_cause"] == 2
+
+
+def test_postmortem_self_fault_reason_rules(tmp_path):
+    _write_dump(tmp_path, 0, [], reason="ranks_failed")
+    _write_dump(tmp_path, 1, [], reason="evicted")
+    v = pm.analyze(str(tmp_path))
+    assert v["first_cause"] == 1 and v["gang_ruled"] == [1]
+
+
+def test_postmortem_prefers_direct_dump_over_pulled(tmp_path):
+    _write_dump(tmp_path, 1, [], reason="evicted")
+    _write_dump(tmp_path, 1, [], reason="coordinator_pull", pulled=True)
+    dumps = pm.load_dir(str(tmp_path))
+    assert dumps[1]["reason"] == "evicted"
+    assert dumps[1]["_pulled"] is False
+
+
+def test_postmortem_tolerates_torn_and_foreign_files(tmp_path):
+    (tmp_path / "blackbox_rank9.json").write_text('{"torn')
+    (tmp_path / "notes.json").write_text("{}")
+    _write_dump(tmp_path, 0, [], reason="engine_abort")
+    v = pm.analyze(str(tmp_path))
+    assert v["dumped_ranks"] == [0]
+    assert pm.analyze(str(tmp_path / "nothing-here")) is None
+
+
+def test_postmortem_cli_empty_dir_fails(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, TOOL, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60,
+                         env=env)
+    assert res.returncode == 1
+    assert "no loadable" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gangs
+# ---------------------------------------------------------------------------
+
+
+def _schema_valid(doc, rank):
+    assert doc["schema"] == "hvd-blackbox-v1"
+    assert doc["rank"] == rank
+    assert isinstance(doc["events"], list) and doc["events"]
+    assert "reason" in doc
+    return True
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.parametrize("mode", ["stall", "kill"])
+def test_gang_failure_ships_its_own_evidence(tmp_path, mode):
+    """One rank of three fails mid-collective.  ``stall`` wedges the
+    victim's data plane (process alive — the coordinator must PULL its
+    ring over the still-live control channel); ``kill`` is the SIGKILL
+    death that leaves no dump at all (the verdict is reconstructed from
+    the survivors' evidence).  Either way: every survivor exits 0 with
+    a schema-valid ``blackbox_rank<r>.json``, and hvd_postmortem.py
+    names exactly the victim as first cause."""
+    np_, victim = 3, 2
+    bb_dir = tmp_path / "bb"
+    server = RendezvousServer("127.0.0.1")
+    port = server.start()
+    procs = []
+    try:
+        for rank in range(np_):
+            env = dict(os.environ)
+            env.pop(fi.ENV_VAR, None)
+            env["PYTHONPATH"] = (REPO + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            env.update({
+                "HVD_RANK": str(rank),
+                "HVD_SIZE": str(np_),
+                "HVD_LOCAL_RANK": str(rank),
+                "HVD_LOCAL_SIZE": str(np_),
+                "HVD_CROSS_RANK": "0",
+                "HVD_CROSS_SIZE": "1",
+                "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HVD_RENDEZVOUS_PORT": str(port),
+                "JAX_PLATFORMS": "cpu",
+                "HVD_TPU_CORE": "py",
+                "HVD_SHM_DISABLE": "1",
+                "HVD_ELASTIC_EPOCH": "0",
+                "HVD_ELASTIC_MIN_NP": "2",
+                "HVD_ELASTIC_MAX_NP": str(np_),
+                "HVD_ELASTIC_UID": f"uid-{rank}",
+                "HVD_ELASTIC_CHECK_INTERVAL_S": "0.05",
+                "HVD_COLLECTIVE_TIMEOUT": str(TIMEOUT_S),
+                "HVD_COLLECTIVE_PROBE_TIMEOUT": "0.5",
+                "HVD_RECONNECT_TIMEOUT_S": "1",
+                "HVD_BLACKBOX_DIR": str(bb_dir),
+                "BLACKBOX_MODE": mode,
+            })
+            if mode == "kill":
+                # A SIGKILL'd peer surfaces as a connection reset; the
+                # recovery ladder (rung 2's failed reconnect) is what
+                # escalates that into the typed gang-wide abort.  The
+                # ladder notices the death ~1s in — a full second before
+                # the other survivor's own 2s deadline — so the probe
+                # window must stay open long enough for that rank's
+                # timeout report to arrive, or busy-and-silent would
+                # sweep an innocent rank into the verdict.
+                env["HVD_WIRE_CRC"] = "1"
+                env["HVD_COLLECTIVE_PROBE_TIMEOUT"] = "3.0"
+            if rank == victim:
+                env["BLACKBOX_VICTIM"] = "1"
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+        outs = {}
+        deadline = time.monotonic() + 120.0
+        for rank in range(np_ - 1):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                out, err = procs[rank].communicate(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    f"survivor rank {rank} hung: the gang-wide abort "
+                    "never released it")
+            outs[rank] = (procs[rank].returncode, out.decode(),
+                          err.decode())
+        if mode == "stall":
+            assert procs[victim].poll() is None, \
+                "the victim exited on its own — the stall never wedged it"
+            procs[victim].kill()
+        v_out, v_err = procs[victim].communicate(timeout=30)
+        outs[victim] = (procs[victim].returncode, v_out.decode(),
+                        v_err.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+    # -- the victim: never finished ------------------------------------
+    v_code, v_out, v_err = outs[victim]
+    assert v_code != 0, (v_code, v_out, v_err)
+    assert "DONE" not in v_out, v_out
+    if mode == "kill":
+        assert v_code == 137, (v_code, v_err)    # os._exit mid-hop
+
+    # -- the survivors: typed abort naming the victim, then recovery ---
+    for rank in range(np_ - 1):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        m = re.search(r"FAIL (\w+) ranks=(\[[^\]]*\])", out)
+        assert m, (rank, out, err)
+        assert json.loads(m.group(2)) == [victim], (rank, out)
+        assert "DONE" in out, out
+
+    # -- every survivor wrote a schema-valid direct dump ----------------
+    for rank in range(np_ - 1):
+        doc = json.loads(
+            (bb_dir / f"blackbox_rank{rank}.json").read_text())
+        assert _schema_valid(doc, rank)
+        assert doc["reason"] in ("collective_timeout", "ranks_failed"), \
+            doc["reason"]
+        kinds = {e["kind"] for e in doc["events"]}
+        assert "collective.begin" in kinds, kinds
+        assert kinds & {"abort.verdict", "evict"}, kinds
+
+    if mode == "stall":
+        # -- the coordinator PULLED the wedged rank's ring -------------
+        pulled = json.loads(
+            (bb_dir / f"blackbox_rank{victim}.pulled.json").read_text())
+        assert _schema_valid(pulled, victim)
+        assert pulled["reason"] == "coordinator_pull"
+        assert pulled["in_flight"]["name"].startswith("grad")
+        # The victim never dumped itself — its background thread is the
+        # wedged one.  The pull is the only copy of its ring.
+        assert not (bb_dir / f"blackbox_rank{victim}.json").exists()
+    else:
+        # SIGKILL leaves nothing from the victim, direct or pulled.
+        assert not (bb_dir / f"blackbox_rank{victim}.json").exists()
+
+    # -- the postmortem names exactly the victim ------------------------
+    v = pm.analyze(str(bb_dir))
+    assert v is not None
+    assert v["first_cause"] == victim, v
+    assert v["gang_ruled"] == [victim], v
+    if mode == "stall":
+        # Phase + peer come from the victim's own pulled ring.
+        assert v["doing"]["name"].startswith("grad"), v["doing"]
+        assert v["doing"]["phase"] == "collective", v["doing"]
+        assert v["doing"]["peer"] == (victim - 1) % np_, v["doing"]
+    else:
+        # Reconstructed from the survivors' blame edges.
+        assert v["doing"]["name"].startswith("grad"), v["doing"]
+        assert v["doing"]["phase"], v["doing"]
+
+    # -- and the CLI verdict is operator-readable ------------------------
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, TOOL, str(bb_dir)],
+                         capture_output=True, text=True, timeout=60,
+                         env=env)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert f"postmortem: {bb_dir}" in res.stdout
+    assert f"first cause: rank {victim}" in res.stdout
+    res_json = subprocess.run([sys.executable, TOOL, str(bb_dir),
+                               "--json"],
+                              capture_output=True, text=True, timeout=60,
+                              env=env)
+    assert json.loads(res_json.stdout)["first_cause"] == victim
+
+
+# ---------------------------------------------------------------------------
+# abort messages point at the evidence
+# ---------------------------------------------------------------------------
+
+
+def test_postmortem_suffix_on_elastic_errors(monkeypatch):
+    import importlib
+
+    # `horovod_tpu.elastic.run` the attribute is the decorator, which
+    # shadows the submodule on `from ... import`.
+    elastic_run = importlib.import_module("horovod_tpu.elastic.run")
+
+    monkeypatch.setenv(env_util.BLACKBOX_DIR, "/tmp/bbx")
+    assert elastic_run._postmortem_suffix() == "; postmortem: /tmp/bbx"
+    monkeypatch.setenv(env_util.BLACKBOX, "0")
+    assert elastic_run._postmortem_suffix() == ""
+
+
+def test_postmortem_suffix_on_launch_error(monkeypatch):
+    from horovod_tpu.runner.launch import LaunchError
+
+    monkeypatch.setenv(env_util.BLACKBOX_DIR, "/tmp/bbx")
+    assert "postmortem: /tmp/bbx" in str(LaunchError(1, 137))
+    monkeypatch.setenv(env_util.BLACKBOX, "0")
+    assert "postmortem" not in str(LaunchError(1, 137))

@@ -598,7 +598,7 @@ def test_steady_state_spawns_no_threads_and_no_payload_allocs():
 
     assert after == before, "steady-state collective changed thread count"
     plane = ("cpu_backend.py", "socketutil.py", "fusion_buffer.py",
-             "transport.py", "trace.py")
+             "transport.py", "trace.py", "blackbox.py")
     offenders = [
         (st.traceback[0].filename, st.traceback[0].lineno, st.size)
         for st in snap.statistics("traceback")

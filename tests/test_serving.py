@@ -116,8 +116,12 @@ def test_scheduler_fifo_packing_and_refill():
         [(0, r1.id), (1, r2.id)]
     assert r1.attempts == 1 and r3.attempts == 0
     assert s.take_admissions() == []  # batch full, r3 waits
-    assert s.stats() == {"queued": 1, "active": 2, "slots": 2,
-                         "completed": 0}
+    st = s.stats()
+    assert {k: st[k] for k in ("queued", "active", "slots",
+                               "completed")} == \
+        {"queued": 1, "active": 2, "slots": 2, "completed": 0}
+    assert st["last_step_age_s"] == 0.0      # no step confirmed yet
+    assert st["oldest_queued_age_s"] < 5.0   # r3 queued just now
     # Retiring slot 0 opens it for the queued request at the next
     # token boundary — continuous batching, not batch-at-a-time.
     s.on_token(0, 5)
@@ -126,6 +130,28 @@ def test_scheduler_fifo_packing_and_refill():
     adm = s.take_admissions()
     assert [(slot, r.id) for slot, r in adm] == [(0, r3.id)]
     assert s.stats()["completed"] == 1
+
+
+def test_scheduler_staleness_ages():
+    """The /stats staleness surface: last_step_age_s tracks the loop's
+    note_step() stamps, oldest_queued_age_s the head-of-line wait — the
+    two numbers an external router probes to tell a wedged gang from an
+    idle one."""
+    s = Scheduler(max_batch=1, max_queue=4, cache_len=16)
+    st = s.stats()
+    assert st["last_step_age_s"] == 0.0      # no step this incarnation
+    assert st["oldest_queued_age_s"] == 0.0  # empty queue
+    s.note_step(time.monotonic() - 5.0)
+    assert 4.5 < s.stats()["last_step_age_s"] < 60.0
+    r = s.submit([1], 2)
+    r.t_submit = time.monotonic() - 2.0      # backdate the head-of-line
+    assert 1.5 < s.stats()["oldest_queued_age_s"] < 60.0
+    # Both land in the metrics registry as gauges.
+    from horovod_tpu.telemetry import registry as tmx
+    snap = tmx.snapshot()
+    if snap:                                  # metrics may be disabled
+        assert "hvd_serve_last_step_age_seconds" in snap
+        assert "hvd_serve_oldest_queued_age_seconds" in snap
 
 
 def test_scheduler_ttft_and_token_tail():

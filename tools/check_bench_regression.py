@@ -4,8 +4,12 @@
 Compares the two most recent ``BENCH_r*.json`` snapshots at the repo
 root (ordered by round number) and fails when any **shared** throughput
 metric — a key ending in ``_per_sec`` — dropped by more than the
-tolerance (default 20%).  Latency metrics (``*_ms``) are noisy in CI and
-direction-ambiguous across workload changes, so only throughput gates.
+tolerance (default 20%), or any shared tail/median latency metric — a
+key ending in ``_p99_ms`` / ``_p50_ms`` — rose by more than the same
+tolerance.  Other ``*_ms`` keys (plain means, durations) stay
+informational: they are noisy in CI and direction-ambiguous across
+workload changes, but a percentile that moves 20%+ is a real serving
+regression.
 
 Metrics present in one round but not the other are reported as info and
 ignored: benchmarks grow with the repo and a new metric has no baseline
@@ -118,6 +122,22 @@ def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
         if ratio < 1.0 - tolerance:
             problems.append(
                 f"{k} dropped {(1.0 - ratio) * 100:.1f}% "
+                f"(r{old_n}={old[k]:g} -> r{new_n}={new[k]:g}, "
+                f"tolerance {tolerance * 100:.0f}%)")
+    # Latency gate: shared percentile metrics must not RISE past the
+    # tolerance (higher = worse, the mirror image of throughput).
+    lat = {k for k in set(old) & set(new)
+           if k.endswith(("_p99_ms", "_p50_ms"))}
+    for k in sorted(lat):
+        if old[k] <= 0:
+            continue
+        ratio = new[k] / old[k]
+        marker = "REGRESSION" if ratio > 1.0 + tolerance else "ok"
+        print(f"  {marker}: {k}: r{old_n}={old[k]:g} -> r{new_n}={new[k]:g} "
+              f"({(ratio - 1.0) * 100:+.1f}%)")
+        if ratio > 1.0 + tolerance:
+            problems.append(
+                f"{k} rose {(ratio - 1.0) * 100:.1f}% "
                 f"(r{old_n}={old[k]:g} -> r{new_n}={new[k]:g}, "
                 f"tolerance {tolerance * 100:.0f}%)")
     old_pb, new_pb = load_phase_breakdown(old_p), load_phase_breakdown(new_p)
