@@ -614,6 +614,20 @@ def main() -> None:
     except Exception as e:
         extras["trace_bench_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # --- control-plane scale: coordination-cycle latency vs ranks -------
+    # 8/64/256 in-process ranks over socketpairs (horovod_tpu/ctrl_sim),
+    # flat star vs the hierarchical per-host sub-coordinator tree
+    # (docs/fault_tolerance.md).  Headline ``coordination_cycle_p50_us``
+    # is the tree's p50 at 256 ranks — the proof point the regression
+    # gate watches; the per-size/per-mode keys carry the full curve.
+    try:
+        from horovod_tpu import ctrl_sim
+
+        curve = ctrl_sim.run_curve()
+        extras.update(curve)
+    except Exception as e:
+        extras["ctrl_sim_error"] = f"{type(e).__name__}: {e}"[:200]
+
     baseline = 1656.82 / 16.0  # reference's per-device number
     line = {
         "metric": "resnet50_synthetic_images_per_sec_per_chip"

@@ -152,6 +152,24 @@ constexpr uint8_t kTagClockPong = 15;
 constexpr uint8_t kTagBlackbox = 16;
 constexpr uint8_t kTagBlackboxDump = 17;
 
+// Control tags 18-21 are reserved by the Python engine's hierarchical
+// control tree and epoch fencing (runtime_py.py; docs/fault_tolerance.md
+// "Hierarchical control plane, fencing, and quorum"):
+// kTagTreeUp = 18 (u32 epoch, u32 n, { i32 rank, u8 tag, u32 len,
+// bytes }[n] — a per-host sub-coordinator's aggregate of its children's
+// control frames), kTagTreeDown = 19 (i32 target_rank, u8 tag, u32 len,
+// bytes — a root frame routed through the sub-coordinator; -1 fans out
+// to every child), kTagReparent = 20 (i32 rank, i32 old_parent,
+// u32 epoch — an orphaned child adopting itself back to the root), and
+// kTagFence = 21 (u32 stale_epoch, u32 current_epoch — typed rejection
+// of a stale-epoch sender).  A native engine never joins a tree: a
+// multi-host Python gang only builds one among Python ranks, so like
+// the abort tags these frames never reach this decoder.
+constexpr uint8_t kTagTreeUp = 18;
+constexpr uint8_t kTagTreeDown = 19;
+constexpr uint8_t kTagReparent = 20;
+constexpr uint8_t kTagFence = 21;
+
 // CRC-32 (zlib polynomial), seed 0 — matches Python's zlib.crc32.
 uint32_t WireCrc32(const uint8_t* data, size_t len, uint32_t crc = 0);
 

@@ -32,6 +32,7 @@ def bootstrap_mesh(
     rdv_port: int,
     shm_capable: bool = False,
     keep_listener: bool = False,
+    tree: Optional[dict] = None,
 ):
     """Returns ``(data, ctrl_sock, ctrl_socks, kv, prefix)``:
 
@@ -52,6 +53,14 @@ def bootstrap_mesh(
     the listener: ``peers`` maps rank -> advertised ``(host, port)`` and
     the still-open listener accepts rung-2 reconnect re-dials for the
     life of the gang (utils/ladder.py ``ReconnectListener``).
+
+    ``tree`` (hierarchical control plane, runtime_py._plan_tree): an
+    in/out dict with ``parent`` (this rank's sub-coordinator, or None)
+    and ``children`` (ranks this sub-coordinator folds).  The extra
+    links ride the same listener on channel 2; on return the dict gains
+    ``parent_sock`` (child's uplink, or None) and ``child_socks``
+    (sub-coordinator's rank -> socket map).  The return tuple shapes
+    are unchanged — flat-star callers pass nothing and see nothing.
     """
     from horovod_tpu.runner.http_client import KVClient
     from horovod_tpu.utils import transport as tpt
@@ -101,9 +110,13 @@ def bootstrap_mesh(
     ctrl_sock: Optional[socket.socket] = None
     ctrl_socks: Dict[int, socket.socket] = {}
 
+    tree_parent = tree.get("parent") if tree else None
+    tree_children = list(tree.get("children") or []) if tree else []
+
     n_accept = size - 1 - rank
     if rank == 0:
         n_accept += size - 1  # ctrl connections
+    n_accept += len(tree_children)  # chan-2 tree uplinks
     accept_results: Dict[Tuple[int, int], socket.socket] = {}
 
     def _accept_loop():
@@ -126,15 +139,30 @@ def bootstrap_mesh(
         s = su.connect_retry(*peers[0], timeout=start_timeout)
         s.sendall(struct.pack("<ii", rank, 1))
         ctrl_sock = s
+    if tree_parent is not None:
+        # Every rank keeps its direct ctrl link above; the tree uplink
+        # is an ADDITIONAL channel to the same-host sub-coordinator, so
+        # a dead sub-coordinator orphan can fall back to the star
+        # without re-dialing anything.
+        s = su.connect_retry(*peers[tree_parent], timeout=start_timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(struct.pack("<ii", rank, 2))
+        tree["parent_sock"] = s
 
     acceptor.join(timeout=start_timeout * 1.5)
     if acceptor.is_alive():
         raise ConnectionError("timed out waiting for peer connections")
+    tree_child_socks: Dict[int, socket.socket] = {}
     for (peer_rank, chan), s in accept_results.items():
         if chan == 0:
             data[peer_rank] = s
+        elif chan == 2:
+            tree_child_socks[peer_rank] = s
         else:
             ctrl_socks[peer_rank] = s
+    if tree is not None:
+        tree.setdefault("parent_sock", None)
+        tree["child_socks"] = tree_child_socks
     if keep_listener:
         return data, ctrl_sock, ctrl_socks, kv, prefix, peers, listener
     listener.close()

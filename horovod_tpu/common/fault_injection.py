@@ -49,6 +49,16 @@ Fault fields:
 * ``stall_s``— hang duration for ``stall`` / ``halfopen`` (default
   3600 — "forever" at test scale, yet the injected sleeper thread
   still unwinds instead of leaking for the life of the process).
+* ``groups`` — for ``kind: partition`` only: two rank lists.  The fault
+  drops (raises :class:`InjectedFault` for) every frame whose
+  (this-process rank, ``detail`` peer rank) pair crosses the two
+  groups, in BOTH directions — a network partition between host
+  groups, not a single dead link.  This-process rank comes from
+  ``HVD_RANK``; when ``detail`` names the sender itself (the
+  ``ctrl.worker.send`` convention) the remote is the root, rank 0.
+  Frames within one group never fire, so each side keeps running and
+  independently concludes the other side died — exactly the split the
+  elastic quorum gate must resolve.
 """
 
 from __future__ import annotations
@@ -84,6 +94,10 @@ KNOWN_SITES = {
     "engine.cycle": "PyEngine background cycle",
     "ctrl.worker.send": "worker->coordinator control send",
     "ctrl.coord.send": "coordinator->worker control send",
+    "ctrl.subcoord.send": "sub-coordinator control forward (TREE_UP "
+                          "aggregate to root / routed frame to a child)",
+    "ctrl.reparent": "orphaned child's TAG_REPARENT adoption back to "
+                     "the root after its sub-coordinator died",
     "sock.stall": "data-plane ring-hop receive (hang simulation)",
     "sock.halfopen": "persistent sender thread send (half-open sim)",
     "sock.corrupt": "flip one wire byte of a ladder data frame (CRC)",
@@ -116,13 +130,13 @@ class InjectedFault(ConnectionError):
 
 class _Fault:
     __slots__ = ("site", "kind", "match", "times", "after", "prob",
-                 "delay_s", "stall_s", "hits", "fired")
+                 "delay_s", "stall_s", "groups", "hits", "fired")
 
     def __init__(self, spec: dict):
         self.site = spec["site"]
         self.kind = spec.get("kind", "error")
         if self.kind not in ("drop", "error", "delay", "kill", "corrupt",
-                             "stall", "halfopen"):
+                             "stall", "halfopen", "partition"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         self.match = spec.get("match")
         self.times = spec.get("times")
@@ -130,6 +144,17 @@ class _Fault:
         self.prob = spec.get("prob")
         self.delay_s = float(spec.get("delay_s", 0.1))
         self.stall_s = float(spec.get("stall_s", 3600.0))
+        groups = spec.get("groups")
+        if self.kind == "partition":
+            if (not isinstance(groups, (list, tuple)) or len(groups) != 2
+                    or not all(isinstance(g, (list, tuple))
+                               for g in groups)):
+                raise ValueError(
+                    "partition fault needs groups: [[ranks...], "
+                    "[ranks...]]")
+            groups = (frozenset(int(r) for r in groups[0]),
+                      frozenset(int(r) for r in groups[1]))
+        self.groups = groups
         self.hits = 0    # matching passes seen
         self.fired = 0   # faults actually injected
 
@@ -171,11 +196,32 @@ def _matches_and_arms(plan: _Plan, f: _Fault, detail: str) -> bool:
     return True
 
 
+def _partition_crosses(f: _Fault, detail: str) -> bool:
+    """True when this frame crosses the partition's two groups: the
+    local process rank (HVD_RANK) on one side, the peer rank named by
+    ``detail`` on the other.  Sites that pass the sender's OWN rank as
+    detail (ctrl.worker.send, a sub-coordinator's TREE_UP) are talking
+    to the root — rank 0 stands in as the remote."""
+    try:
+        me = int(os.environ.get("HVD_RANK", "0"))
+        other = int(detail)
+    except ValueError:
+        return False  # non-rank detail: not a peer-addressed frame
+    if other == me:
+        other = 0
+    g0, g1 = f.groups
+    return (me in g0 and other in g1) or (me in g1 and other in g0)
+
+
 def _fire_slow(plan: _Plan, site: str, detail: str) -> None:
     for f in plan.faults:
         if f.site != site or f.kind == "corrupt":
             # corrupt faults only arm at should_corrupt() sites — a
             # fire() site cannot apply a data corruption.
+            continue
+        if f.kind == "partition" and not _partition_crosses(f, detail):
+            # Same-side traffic flows; only cross-group frames are cut
+            # (and only those count against times/prob bookkeeping).
             continue
         if not _matches_and_arms(plan, f, detail):
             continue

@@ -206,6 +206,32 @@ class CollectiveTimeoutError(RanksFailedError):
             f"the wedged rank(s) and replay the aborted batch",)
 
 
+class FencedError(RuntimeError):
+    """A stale-epoch actor was rejected by the current gang incarnation.
+
+    Raised on a **zombie** — a rank that was evicted (long GC pause,
+    network blip, chaos stall) while the survivors re-formed at a newer
+    membership epoch — when it wakes up and tries to write into the new
+    gang: a control frame gets a ``TAG_FENCE`` reply from the
+    coordinator, a KV write under ``elastic/*`` gets HTTP 409 from the
+    rendezvous server.  Deliberately NOT a :class:`RanksFailedError`
+    subclass: the elastic wrapper re-forms on those, but a fenced rank
+    has no seat in the new world — it must exit, and the typed class is
+    how the training loop tells "my peers died, re-form" apart from
+    "I am the zombie, stop".
+    """
+
+    def __init__(self, what: str, stale_epoch: int, current_epoch: int):
+        self.what = what
+        self.stale_epoch = int(stale_epoch)
+        self.current_epoch = int(current_epoch)
+        super().__init__(
+            f"fenced {what}: this rank is at membership epoch "
+            f"{self.stale_epoch} but the gang re-formed at epoch "
+            f"{self.current_epoch}; this process was evicted and has no "
+            f"seat in the new world — exit instead of corrupting it")
+
+
 class StatusType(enum.IntEnum):
     OK = 0
     UNKNOWN_ERROR = 1

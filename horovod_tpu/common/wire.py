@@ -611,3 +611,82 @@ def decode_serve_delta_ex(data: bytes):
     if off < len(data):
         leader_addr, off = _unpack_str(data, off)
     return seq, bool(stop), admissions, epoch, leader_addr
+
+
+# -- hierarchical control tree (docs/fault_tolerance.md "Hierarchical
+#    control plane, fencing, and quorum") --------------------------------
+#
+# Layout (little-endian, like everything above; values reserved in
+# csrc/wire.h — the native engine refuses the tags cleanly and never
+# joins a tree):
+#
+#   TreeUp   := u32 epoch, u32 n, { i32 rank, u8 tag, varstr payload }[n]
+#   TreeDown := i32 target_rank (-1 = every child), u8 tag, varstr payload
+#   Reparent := i32 rank, i32 old_parent, u32 epoch
+#   Fence    := u32 stale_epoch, u32 current_epoch
+#
+# TreeUp is tag-transparent: a sub-coordinator folds whatever frames its
+# children sent it (TAG_REQUEST_LIST ready ticks, TAG_HEARTBEAT, probe
+# acks) into one aggregate, and the root dispatches each entry exactly
+# as if it had arrived on that rank's own control socket.  TreeDown
+# routes a root frame (TAG_PROBE today) through the sub-coordinator to
+# one child or to the whole host.
+
+
+def encode_tree_up(entries, epoch: int = 0) -> bytes:
+    """Sub-coordinator -> root: ``entries`` = [(rank, tag, payload)]."""
+    buf = bytearray(struct.pack("<II", epoch, len(entries)))
+    for rank, tag, payload in entries:
+        buf += struct.pack("<iBI", rank, tag, len(payload))
+        buf += payload
+    return bytes(buf)
+
+
+def decode_tree_up(data: bytes):
+    """Returns ``(entries, epoch)`` with entries = [(rank, tag, payload)]."""
+    epoch, n = struct.unpack_from("<II", data, 0)
+    off = 8
+    entries = []
+    for _ in range(n):
+        rank, tag, plen = struct.unpack_from("<iBI", data, off)
+        off += 9
+        entries.append((rank, tag, bytes(data[off:off + plen])))
+        off += plen
+    return entries, epoch
+
+
+def encode_tree_down(target_rank: int, tag: int, payload: bytes) -> bytes:
+    """Root -> sub-coordinator: forward ``(tag, payload)`` to
+    ``target_rank`` (-1 = every child on that host)."""
+    return struct.pack("<iBI", target_rank, tag, len(payload)) + payload
+
+
+def decode_tree_down(data: bytes):
+    """Returns ``(target_rank, tag, payload)``."""
+    target, tag, plen = struct.unpack_from("<iBI", data, 0)
+    return target, tag, bytes(data[9:9 + plen])
+
+
+def encode_reparent(rank: int, old_parent: int, epoch: int = 0) -> bytes:
+    """Orphaned child -> root: my sub-coordinator ``old_parent`` died;
+    route my control traffic directly from now on."""
+    return struct.pack("<iiI", rank, old_parent, epoch)
+
+
+def decode_reparent(data: bytes):
+    """Returns ``(rank, old_parent, epoch)``."""
+    return struct.unpack_from("<iiI", data, 0)
+
+
+# -- epoch fence (docs/fault_tolerance.md "epoch fencing") ---------------
+
+
+def encode_fence(stale_epoch: int, current_epoch: int) -> bytes:
+    """Coordinator -> a sender whose control frame carried a stale
+    membership epoch: you were evicted/re-formed away; exit."""
+    return struct.pack("<II", stale_epoch, current_epoch)
+
+
+def decode_fence(data: bytes):
+    """Returns ``(stale_epoch, current_epoch)``."""
+    return struct.unpack_from("<II", data, 0)

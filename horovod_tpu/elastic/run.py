@@ -209,11 +209,53 @@ def _set_world_env(rank: int, size: int, epoch: int) -> None:
         f"{base}.elastic-{epoch}" if base else f"elastic-{epoch}")
 
 
+def quorum_lost(roster_size: int, failed: Set[int]) -> bool:
+    """True when the side of the partition this process is on does NOT
+    hold a re-form quorum of the last-committed ``roster_size`` members.
+
+    Strict majority wins; an EXACT half is broken by which side still
+    holds old rank 0 (two live halves must never both win, and exactly
+    one holds it).  The honest limit: when rank 0 is truly dead in an
+    even split, both sides lose and the job needs a full relaunch
+    (docs/fault_tolerance.md)."""
+    n_alive = roster_size - len(failed)
+    return (2 * n_alive < roster_size
+            or (2 * n_alive == roster_size and 0 in failed))
+
+
 def _reform(ctx: _ElasticContext, failed: Set[int]) -> None:
     """Tear down, compute the new world, and re-init under a new epoch."""
     from horovod_tpu import basics, process_sets
 
     t_reform0 = time.monotonic_ns()
+    # Quorum gate (HVD_QUORUM, default on): re-form only when a STRICT
+    # majority of the last-committed membership survived.  A network
+    # partition makes both sides see "the others failed" — without the
+    # gate each side would re-form its own sibling gang under the same
+    # scope and split-brain the job.  The majority side proceeds; a
+    # minority self-terminates with a PARTITION_MINORITY verdict.
+    # Recorded BEFORE teardown so the still-live timeline and flight
+    # recorder capture the verdict.
+    n_alive = len(ctx.roster) - len(failed)
+    if env_util.quorum_on() and ctx.roster \
+            and quorum_lost(len(ctx.roster), failed):
+        _timeline_event("PARTITION_MINORITY", alive=n_alive,
+                        roster=len(ctx.roster), failed=sorted(failed))
+        _bb.note("partition.minority", t_reform0, alive=n_alive,
+                 roster=len(ctx.roster), failed=sorted(failed))
+        _bb.dump("partition_minority",
+                 f"alive={n_alive}/{len(ctx.roster)}")
+        print(f"PARTITION_MINORITY: only {n_alive} of "
+              f"{len(ctx.roster)} last-committed members reachable; "
+              "refusing to re-form a minority gang", flush=True)
+        ctx.stop_driver()
+        basics.shutdown()
+        raise RuntimeError(
+            f"PARTITION_MINORITY: {n_alive}/{len(ctx.roster)} members "
+            f"reachable after failure of rank(s) {sorted(failed)} — no "
+            "strict majority of the last-committed membership; "
+            "self-terminating instead of re-forming a split-brain "
+            "sibling gang" + _postmortem_suffix())
     if 0 in failed:
         _tmx.inc_counter("hvd_leader_failovers_total")
         # Leader failover is a terminal event for the old incarnation:

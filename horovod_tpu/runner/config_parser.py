@@ -21,6 +21,7 @@ _ARG_ENV = {
     "hierarchical_allgather": E.HIERARCHICAL_ALLGATHER,
     "ring_segment_bytes": E.RING_SEGMENT_BYTES,
     "sock_buf_bytes": E.SOCK_BUF_BYTES,
+    "ctrl_fanout": E.CTRL_FANOUT,
     "collective_timeout": E.COLLECTIVE_TIMEOUT,
     "no_shm": E.SHM_DISABLE,
     "shm_slot_bytes": E.SHM_SLOT_BYTES,

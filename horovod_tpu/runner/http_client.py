@@ -25,6 +25,7 @@ Unset, behavior is byte-identical to the single-address client.
 from __future__ import annotations
 
 import os
+import re
 import socket
 import time
 import urllib.error
@@ -34,7 +35,9 @@ from typing import List, Optional, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.common.retry import retry_call
+from horovod_tpu.common.types import FencedError
 from horovod_tpu.runner import secret as secret_mod
+from horovod_tpu.runner.http_server import EPOCH_HEADER
 from horovod_tpu.telemetry import blackbox as _bb
 from horovod_tpu.telemetry import registry as _tmx
 from horovod_tpu.utils import env as env_util
@@ -126,6 +129,15 @@ class KVClient:
         path = f"{endpoint}{key}"
         req = urllib.request.Request(self._url(path), data=body,
                                      method=method)
+        if method in ("PUT", "DELETE") and "elastic/" in key:
+            # Epoch fence (docs/fault_tolerance.md): stamp elastic
+            # mutations with this process's membership epoch so a
+            # zombie's stale write gets a 409 instead of corrupting the
+            # re-formed gang's rosters.  Non-elastic processes carry no
+            # epoch and never fence.
+            epoch = os.environ.get(env_util.ELASTIC_EPOCH, "")
+            if epoch:
+                req.add_header(EPOCH_HEADER, epoch)
         if self.secret is not None:
             req.add_header(secret_mod.HEADER, secret_mod.sign(
                 self.secret, method, path, body or b""))
@@ -149,14 +161,38 @@ class KVClient:
             is_retryable=_retryable, on_retry=on_retry,
             seed=zlib.crc32(key.encode("utf-8")))
 
+    def _raise_if_fenced(self, e: urllib.error.HTTPError,
+                         key: str) -> None:
+        """Turn the server's 409 epoch-fence rejection into the typed
+        FencedError the elastic wrapper dispatches on (a zombie exits;
+        it does NOT re-form)."""
+        if e.code != 409:
+            return
+        try:
+            detail = e.read().decode("utf-8", "replace")
+        except Exception:
+            detail = ""
+        m = re.search(r"epoch (\d+) is stale.* epoch (\d+)", detail)
+        if m:
+            stale, current = int(m.group(1)), int(m.group(2))
+        else:
+            stale = env_util.get_int(env_util.ELASTIC_EPOCH, 0)
+            current = -1
+        raise FencedError(f"kv write {key!r}", stale, current) from None
+
     def put(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode("utf-8")
 
         def go():
-            with urllib.request.urlopen(self._request(key, "PUT", value),
-                                        timeout=self.timeout):
-                pass
+            try:
+                with urllib.request.urlopen(
+                        self._request(key, "PUT", value),
+                        timeout=self.timeout):
+                    pass
+            except urllib.error.HTTPError as e:
+                self._raise_if_fenced(e, key)
+                raise
 
         self._with_retry(go, "kv.put", key)
 
@@ -190,9 +226,14 @@ class KVClient:
 
     def delete(self, key: str) -> None:
         def go():
-            with urllib.request.urlopen(self._request(key, "DELETE"),
-                                        timeout=self.timeout):
-                pass
+            try:
+                with urllib.request.urlopen(
+                        self._request(key, "DELETE"),
+                        timeout=self.timeout):
+                    pass
+            except urllib.error.HTTPError as e:
+                self._raise_if_fenced(e, key)
+                raise
 
         self._with_retry(go, "kv.delete", key)
 

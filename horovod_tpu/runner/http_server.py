@@ -22,6 +22,15 @@ over the same HMAC'd protocol (chaos site ``kv.mirror``; a failed mirror
 write is logged and dropped — the standby's ``/kvsync`` catch-up on
 restart is the repair path).  Clients fail over between primary and
 standbys via ``HVD_KV_ADDRS`` (see runner/http_client.py).
+
+Epoch fencing (docs/fault_tolerance.md "Hierarchical control plane,
+fencing, and quorum"): a mutation on an ``elastic/*`` key may carry an
+``X-HVD-Epoch: <n>`` header — the writer's membership epoch.  The server
+remembers the newest epoch seen per elastic namespace and answers any
+OLDER write with 409, so a zombie (evicted rank resuming after the gang
+re-formed) cannot corrupt the new incarnation's rosters or assignments.
+The header forwards to mirrors so standbys fence identically; writes
+without the header (bootstrap, non-elastic keys) are untouched.
 """
 
 from __future__ import annotations
@@ -36,6 +45,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from horovod_tpu.common import fault_injection as _fi
 from horovod_tpu.runner import secret as secret_mod
+from horovod_tpu.telemetry import registry as _tmx
+
+# Writer's membership epoch on elastic/* mutations (http_client.py
+# stamps it from HVD_ELASTIC_EPOCH; wire-protocol cousin: TAG_FENCE).
+EPOCH_HEADER = "X-HVD-Epoch"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -72,6 +86,40 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(403)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+    def _fenced(self, key: Optional[str]) -> bool:
+        """Epoch fence: True (and 409 already sent) when this mutation
+        carries a stale membership epoch for its elastic namespace.
+        Writes without the header — bootstrap addresses, results,
+        non-elastic keys — never fence."""
+        hdr = self.headers.get(EPOCH_HEADER)
+        if not key or hdr is None:
+            return False
+        idx = key.find("elastic/")
+        if idx < 0:
+            return False
+        try:
+            epoch = int(hdr)
+        except ValueError:
+            return False
+        scope = key[:idx]
+        srv = self.server
+        with srv.kv_lock:  # type: ignore[attr-defined]
+            newest = srv.kv_epochs.get(scope, -1)  # type: ignore
+            if epoch < newest:
+                stale = True
+            else:
+                stale = False
+                srv.kv_epochs[scope] = epoch  # type: ignore
+        if stale:
+            _tmx.inc_counter("hvd_fenced_writes_total")
+            body = (f"fenced: epoch {epoch} is stale, the gang "
+                    f"re-formed at epoch {newest}").encode("utf-8")
+            self.send_response(409)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        return stale
 
     def do_GET(self):
         if self._chaos_unavailable():
@@ -132,11 +180,14 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized(body):
             self._reject()
             return
+        if self._fenced(key):
+            return
         if key:
             with self.server.kv_lock:  # type: ignore[attr-defined]
                 self._store()[key] = body
             self.server.mirror_write(  # type: ignore[attr-defined]
-                "PUT", key, body)
+                "PUT", key, body,
+                epoch=self.headers.get(EPOCH_HEADER))
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -148,11 +199,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reject()
             return
         key = self.path[len("/kv/"):] if self.path.startswith("/kv/") else None
+        if self._fenced(key):
+            return
         with self.server.kv_lock:  # type: ignore[attr-defined]
             self._store().pop(key, None)
         if key:
             self.server.mirror_write(  # type: ignore[attr-defined]
-                "DELETE", key, None)
+                "DELETE", key, None,
+                epoch=self.headers.get(EPOCH_HEADER))
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
@@ -168,9 +222,12 @@ class _KVServer(ThreadingHTTPServer):
     kv_secret: Optional[str]
     kv_mirrors: List[Tuple[str, int]]
     kv_mirror_timeout: float
+    # Epoch fence state: elastic namespace prefix -> newest epoch seen.
+    kv_epochs: Dict[str, int]
 
     def mirror_write(self, method: str, key: str,
-                     body: Optional[bytes]) -> None:
+                     body: Optional[bytes],
+                     epoch: Optional[str] = None) -> None:
         """Forward an accepted mutation to every standby.  Best-effort:
         a dead/slow mirror costs one short timeout, never the request —
         the standby repairs itself on restart via ``/kvsync``.  The
@@ -183,6 +240,11 @@ class _KVServer(ThreadingHTTPServer):
                 req = urllib.request.Request(
                     f"http://{host}:{port}{path}", data=body,
                     method=method)
+                if epoch is not None:
+                    # Standbys fence identically: a zombie that fails
+                    # over to a mirror after a primary 409 gets the
+                    # same answer there.
+                    req.add_header(EPOCH_HEADER, epoch)
                 if self.kv_secret is not None:
                     req.add_header(secret_mod.HEADER, secret_mod.sign(
                         self.kv_secret, method, path, body or b""))
@@ -209,6 +271,7 @@ class RendezvousServer:
                  mirror_timeout: float = 2.0):
         self._httpd = _KVServer((host, port), _Handler)
         self._httpd.kv_store = {}
+        self._httpd.kv_epochs = {}
         self._httpd.kv_lock = threading.Lock()
         self._httpd.kv_secret = secret
         self._httpd.kv_mirrors = [(h, int(p)) for h, p in (mirrors or [])]

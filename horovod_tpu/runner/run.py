@@ -234,6 +234,12 @@ def make_parser() -> argparse.ArgumentParser:
                            "CollectiveTimeoutError instead of "
                            "deadlocking; 0 (default) blocks forever "
                            "(see docs/fault_tolerance.md)")
+    tune.add_argument("--ctrl-fanout", type=int, dest="ctrl_fanout",
+                      help="max children a per-host control-plane "
+                           "sub-coordinator folds before the next host "
+                           "rank goes direct to the root; 0 (default) "
+                           "folds the whole host (see "
+                           "docs/fault_tolerance.md)")
     tune.add_argument("--no-shm", action="store_true", dest="no_shm",
                       help="disable the same-host shared-memory "
                            "transport: every peer link uses TCP, the "
@@ -386,6 +392,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             return 2
     for flag, val in (("--ring-segment-bytes", args.ring_segment_bytes),
                       ("--sock-buf-bytes", args.sock_buf_bytes),
+                      ("--ctrl-fanout", args.ctrl_fanout),
                       ("--collective-timeout", args.collective_timeout)):
         if val is not None and val < 0:
             print(f"{_prog_name()}: {flag} must be >= 0 "

@@ -45,6 +45,7 @@ from horovod_tpu.common import response_cache as rcache
 from horovod_tpu.common.types import (
     CollectiveTimeoutError,
     DataType,
+    FencedError,
     RanksFailedError,
     ReduceOp,
     Request,
@@ -189,6 +190,13 @@ class _MessageTable:
         global-set-only, so joined_size does not apply)."""
         key = self.key_of(req)
         lst = self.entries.setdefault(key, [])
+        if any(q.request_rank == req.request_rank for q in lst):
+            # Duplicate ready tick from the same rank: a child re-sends
+            # its in-flight request frames after re-parenting away from
+            # a dead sub-coordinator, and the original may have been
+            # relayed just before the parent died.  Counting it twice
+            # would fire the collective before every rank is in.
+            return False
         lst.append(req)
         self.first_seen.setdefault(key, time.monotonic())
         if req.process_set_id:
@@ -455,6 +463,7 @@ class PyEngine(_EngineBase):
         self._closed = False  # shutdown() ran its cleanup (socket close)
         self._aborted = False
         self._abort_reason = None
+        self._abort_exc = None  # typed abort (e.g. FencedError)
 
         # coordinator state
         self._msg_table = _MessageTable(size) if rank == 0 else None
@@ -462,6 +471,31 @@ class PyEngine(_EngineBase):
         self._ctrl_inbox: "list" = []
         self._ctrl_lock = threading.Lock()
         self._last_stall_check = time.monotonic()
+
+        # Hierarchical control tree (docs/fault_tolerance.md
+        # "Hierarchical control plane, fencing, and quorum").  Planned
+        # from the block topology BEFORE bootstrap: on a multi-host gang
+        # the lowest local rank of each non-root host becomes a
+        # sub-coordinator that folds its children's request/heartbeat
+        # frames into one TAG_TREE_UP aggregate, so root-side recv work
+        # is O(hosts), not O(ranks).  Single-host gangs plan an empty
+        # tree and stay byte-identical to the seed star (pinned by
+        # tests/test_ctrl_tree.py).
+        self.ctrl_fanout = env_util.ctrl_fanout()
+        self._tree_parent, self._tree_children, self._rank_route = \
+            self._plan_tree()
+        self._tree_parent_sock = None          # child: link to sub-coord
+        self._tree_child_socks: Dict[int, socket.socket] = {}  # sub-coord
+        self._tree_up_buf: List[tuple] = []    # sub-coord: pending entries
+        self._tree_up_lock = threading.Lock()
+        self._tree_orphaned = False            # child: sub-coord died
+        # Child: request payloads sent up the tree since the last
+        # response frame — re-sent after a re-parent because the dead
+        # sub-coordinator may not have relayed them (bounded; the
+        # coordinator absorbs duplicates idempotently).
+        self._tree_unacked: List[bytes] = []
+        self._reparented_ranks: set = set()    # root: adopted orphans
+        self._fenced: Optional[tuple] = None   # worker: TAG_FENCE payload
 
         # Liveness (parity-extension): heartbeats piggyback on the ctrl
         # connections; a worker silent past the timeout is evicted via
@@ -557,6 +591,50 @@ class PyEngine(_EngineBase):
         self._bg.start()
 
     # ------------------------------------------------------------------
+    # hierarchical control tree
+    # ------------------------------------------------------------------
+
+    def _plan_tree(self):
+        """Plan the two-level control tree from the block topology.
+
+        Returns ``(parent, children, route)``:
+
+        * ``parent``: this rank's sub-coordinator (None = talk to the
+          root directly — the root itself, sub-coordinators, the root's
+          own host, and fan-out overflow),
+        * ``children``: ranks this sub-coordinator folds,
+        * ``route``: root-only map child rank -> sub-coordinator rank.
+
+        Empty on a single-host gang (``cross_size == 1``) or a
+        non-block rank layout, where the flat star is already O(hosts):
+        the seed protocol runs byte-identical.
+        """
+        none = (None, [], {})
+        if self.size <= 1 or self.local_size <= 1 or self.cross_size <= 1:
+            return none
+        if not env_util.ctrl_tree_on():
+            return none
+        if not self.hierarchical_topology_ok():
+            return none
+        fanout = self.ctrl_fanout
+        parent, children, route = None, [], {}
+        ls = self.local_size
+        for host in range(1, self.cross_size):
+            sub = host * ls
+            if sub >= self.size:
+                break
+            members = range(sub + 1, min((host + 1) * ls, self.size))
+            folded = list(members if fanout <= 0 else
+                          list(members)[:fanout])
+            for c in folded:
+                route[c] = sub
+                if c == self.rank:
+                    parent = sub
+            if self.rank == sub:
+                children = folded
+        return parent, children, route
+
+    # ------------------------------------------------------------------
     # bootstrap: rendezvous + socket meshes
     # ------------------------------------------------------------------
 
@@ -569,15 +647,19 @@ class PyEngine(_EngineBase):
         # every peer's advertised address for the re-dial.
         ladder_on = env_util.wire_crc()
         self._reconnect_listener = None
+        tree = {"parent": self._tree_parent, "children": self._tree_children}
         if ladder_on:
             (self._data, self._ctrl_sock, self._ctrl_socks,
              kv, kv_prefix, mesh_peers, mesh_listener) = bootstrap_mesh(
                 self.rank, self.size, rdv_addr, rdv_port,
-                shm_capable=True, keep_listener=True)
+                shm_capable=True, keep_listener=True, tree=tree)
         else:
             (self._data, self._ctrl_sock, self._ctrl_socks,
              kv, kv_prefix) = bootstrap_mesh(
-                self.rank, self.size, rdv_addr, rdv_port, shm_capable=True)
+                self.rank, self.size, rdv_addr, rdv_port, shm_capable=True,
+                tree=tree)
+        self._tree_parent_sock = tree.get("parent_sock")
+        self._tree_child_socks = tree.get("child_socks") or {}
 
         # Data-plane hot-path state (docs/performance.md): one transport
         # per peer, selected at mesh-build time (shm ring for same-host
@@ -622,6 +704,12 @@ class PyEngine(_EngineBase):
         else:
             threading.Thread(target=self._worker_recv_loop, daemon=True
                              ).start()
+            if self._tree_parent_sock is not None:
+                threading.Thread(target=self._tree_parent_recv_loop,
+                                 daemon=True).start()
+            for r, s in self._tree_child_socks.items():
+                threading.Thread(target=self._tree_child_recv_loop,
+                                 args=(r, s), daemon=True).start()
         self._response_inbox: List[bytes] = []
         self._response_lock = threading.Lock()
         self._response_cv = threading.Condition(self._response_lock)
@@ -630,104 +718,77 @@ class PyEngine(_EngineBase):
         try:
             while not self._shutdown_flag.is_set():
                 tag, payload = su.recv_frame(sock)
-                # Any frame is proof of life; TAG_HEARTBEAT carries
-                # nothing else.
-                self._last_seen[peer_rank] = time.monotonic()
-                if tag == su.TAG_REQUEST_LIST:
-                    with self._ctrl_lock:
-                        self._ctrl_inbox.append((peer_rank, payload))
-                elif tag in (su.TAG_ABORT_REPORT, su.TAG_PROBE_ACK):
-                    with self._abort_lock:
-                        self._abort_inbox.append(
-                            (peer_rank, tag, payload))
-                elif tag == su.TAG_CLOCK_PING:
-                    # Trace clock sync (telemetry/trace.py): echo the
-                    # worker's t0 with our monotonic read.  Answered
-                    # from THIS thread so the estimate never waits on a
-                    # busy background cycle (cf. TAG_PROBE below).
-                    t0_ns, pepoch = wire.decode_clock_ping(payload)
-                    pong = wire.encode_clock_pong(
-                        t0_ns, time.monotonic_ns(), pepoch)
-                    try:
-                        with self._ctrl_send_lock:
-                            su.send_frame(sock, su.TAG_CLOCK_PONG, pong)
-                    except (ConnectionError, OSError):
-                        pass  # liveness machinery owns the eviction
-                elif tag == su.TAG_BLACKBOX_DUMP:
-                    # A worker's flight-recorder ring, answering our
-                    # post-verdict pull (_pull_blackbox_dumps).
-                    with self._blackbox_lock:
-                        self._blackbox_inbox.append((peer_rank, payload))
+                self._dispatch_ctrl_frame(peer_rank, tag, payload, sock)
         except (ConnectionError, OSError):
             # EOF/reset: fast liveness signal, stronger than a missed
             # heartbeat (only acted on when heartbeats are enabled).
             self._conn_lost.add(peer_rank)
 
+    def _dispatch_ctrl_frame(self, peer_rank: int, tag: int,
+                             payload: bytes, sock) -> None:
+        """Coordinator-side dispatch of one control frame — from a
+        rank's own socket, or replayed from a TAG_TREE_UP aggregate
+        (then ``peer_rank`` is the entry's origin, and ``sock`` the
+        sub-coordinator's link)."""
+        # Any frame is proof of life; TAG_HEARTBEAT carries nothing else.
+        self._last_seen[peer_rank] = time.monotonic()
+        if tag == su.TAG_REQUEST_LIST:
+            with self._ctrl_lock:
+                self._ctrl_inbox.append((peer_rank, payload))
+        elif tag == su.TAG_TREE_UP:
+            # A sub-coordinator's aggregate: dispatch every folded entry
+            # as if it had arrived on its origin rank's own socket.
+            entries, epoch = wire.decode_tree_up(payload)
+            for origin, etag, epayload in entries:
+                self._dispatch_ctrl_frame(origin, etag, epayload, sock)
+        elif tag == su.TAG_REPARENT:
+            rank, old_parent, epoch = wire.decode_reparent(payload)
+            self._note_reparent(peer_rank, old_parent, epoch)
+        elif tag in (su.TAG_ABORT_REPORT, su.TAG_PROBE_ACK):
+            with self._abort_lock:
+                self._abort_inbox.append(
+                    (peer_rank, tag, payload))
+        elif tag == su.TAG_CLOCK_PING:
+            # Trace clock sync (telemetry/trace.py): echo the
+            # worker's t0 with our monotonic read.  Answered
+            # from THIS thread so the estimate never waits on a
+            # busy background cycle (cf. TAG_PROBE).
+            t0_ns, pepoch = wire.decode_clock_ping(payload)
+            pong = wire.encode_clock_pong(
+                t0_ns, time.monotonic_ns(), pepoch)
+            try:
+                with self._ctrl_send_lock:
+                    su.send_frame(sock, su.TAG_CLOCK_PONG, pong)
+            except (ConnectionError, OSError):
+                pass  # liveness machinery owns the eviction
+        elif tag == su.TAG_BLACKBOX_DUMP:
+            # A worker's flight-recorder ring, answering our
+            # post-verdict pull (_pull_blackbox_dumps).
+            with self._blackbox_lock:
+                self._blackbox_inbox.append((peer_rank, payload))
+
+    def _note_reparent(self, rank: int, old_parent: int,
+                       epoch: int) -> None:
+        """Root: a child of a dead sub-coordinator adopted itself back
+        to the direct star.  Only the dead parent gets evicted — the
+        orphan keeps its seat, and its in-flight collectives ride on."""
+        self._reparented_ranks.add(rank)
+        self._rank_route.pop(rank, None)
+        self.log.warning(
+            "rank %d re-parented to the root (sub-coordinator %d died)",
+            rank, old_parent)
+        _tmx.inc_counter("hvd_subcoord_reparents_total")
+        blackbox_mod.note("subcoord.reparent", time.monotonic_ns(),
+                          rank=rank, old_parent=old_parent, epoch=epoch)
+        if self.timeline.enabled:
+            self.timeline.instant(timeline_mod.SUBCOORD_REPARENT,
+                                  rank=rank, old_parent=old_parent)
+
     def _worker_recv_loop(self) -> None:
         try:
             while not self._shutdown_flag.is_set():
                 tag, payload = su.recv_frame(self._ctrl_sock)
-                if tag == su.TAG_RESPONSE_LIST:
-                    with self._response_cv:
-                        self._response_inbox.append(payload)
-                        self._response_cv.notify_all()
-                elif tag == su.TAG_PROBE:
-                    # Answer from THIS thread: the background thread may
-                    # be the very thing that is wedged in the data plane.
-                    since = self._in_collective_since
-                    busy_s = (time.monotonic() - since) if since else 0.0
-                    ack = wire.encode_probe_ack(
-                        since > 0.0, busy_s, self.epoch)
-                    try:
-                        with self._ctrl_send_lock:
-                            su.send_frame(self._ctrl_sock,
-                                          su.TAG_PROBE_ACK, ack)
-                    except (ConnectionError, OSError):
-                        pass
-                elif tag == su.TAG_ABORT_VERDICT:
-                    vname, vranks, vepoch = wire.decode_abort_verdict(
-                        payload)
-                    if vepoch != self.epoch:
-                        continue
-                    with self._abort_cv:
-                        self._abort_verdict = (vname, vranks)
-                        self._abort_cv.notify_all()
-                elif tag == su.TAG_SERVE:
-                    with self._serve_cv:
-                        self._serve_inbox.append(payload)
-                        self._serve_cv.notify_all()
-                elif tag == su.TAG_CLOCK_PONG:
-                    # Midpoint method: offset maps this rank's monotonic
-                    # axis onto rank 0's (add offset to local times).
-                    t1_ns = time.monotonic_ns()
-                    t0_ns, tc_ns, pepoch = wire.decode_clock_pong(payload)
-                    tr = self._tracer
-                    if tr is not None and pepoch == self.epoch:
-                        offset_ns = tc_ns - (t0_ns + t1_ns) // 2
-                        tr.clock(offset_ns, t1_ns - t0_ns)
-                        # The flight recorder rides the same estimate;
-                        # its dump ships the freshest value so the
-                        # postmortem can align rank timelines.
-                        blackbox_mod.note_clock_offset(offset_ns)
-                        if self._metrics_on:
-                            _tmx.set_gauge("hvd_trace_clock_skew_seconds",
-                                           offset_ns / 1e9)
-                elif tag == su.TAG_BLACKBOX:
-                    # Coordinator pulling our flight-recorder ring after
-                    # an abort verdict.  Answered from THIS thread — the
-                    # background thread may be the wedged party, and its
-                    # evidence is exactly what the pull is for.
-                    bb = blackbox_mod.get()
-                    if bb is not None:
-                        blob = bb.dump_bytes("coordinator_pull")
-                        reply = wire.encode_blackbox_dump(
-                            self.rank, self.epoch, blob)
-                        try:
-                            with self._ctrl_send_lock:
-                                su.send_frame(self._ctrl_sock,
-                                              su.TAG_BLACKBOX_DUMP, reply)
-                        except (ConnectionError, OSError):
-                            pass
+                self._dispatch_worker_frame(tag, payload)
         except (ConnectionError, OSError):
             # Coordinator EOF/reset.  During a negotiated shutdown (or
             # after our own close) this is expected teardown noise;
@@ -744,6 +805,171 @@ class PyEngine(_EngineBase):
                 # sleeps a full timeout on a dead hub.
                 with self._serve_cv:
                     self._serve_cv.notify_all()
+
+    def _dispatch_worker_frame(self, tag: int, payload: bytes) -> None:
+        """Worker-side dispatch of one coordinator frame — from the
+        direct control socket, or forwarded down the tree by this
+        rank's sub-coordinator.  Replies (probe acks, blackbox dumps)
+        always go up the DIRECT socket: it stays live even while the
+        sub-coordinator is dying, which is exactly when the coordinator
+        needs them."""
+        if tag == su.TAG_TREE_DOWN:
+            # Sub-coordinator: route a root frame to one child or fan
+            # it out to the whole host.
+            target, itag, ipayload = wire.decode_tree_down(payload)
+            for r, s in list(self._tree_child_socks.items()):
+                if target != -1 and r != target:
+                    continue
+                try:
+                    _fi.fire("ctrl.subcoord.send", str(r))
+                    with self._ctrl_send_lock:
+                        su.send_frame(s, itag, ipayload)
+                except (ConnectionError, OSError):
+                    pass  # the root's liveness machinery owns eviction
+            return
+        if tag == su.TAG_FENCE:
+            # Typed rejection: the coordinator is at a newer membership
+            # epoch and we have no seat in it.  The next worker cycle
+            # raises FencedError to the training loop and exits.
+            self._fenced = wire.decode_fence(payload)
+            with self._serve_cv:
+                self._serve_cv.notify_all()
+            return
+        if tag == su.TAG_RESPONSE_LIST:
+            with self._response_cv:
+                self._response_inbox.append(payload)
+                self._response_cv.notify_all()
+        elif tag == su.TAG_PROBE:
+            # Answer from THIS thread: the background thread may
+            # be the very thing that is wedged in the data plane.
+            since = self._in_collective_since
+            busy_s = (time.monotonic() - since) if since else 0.0
+            ack = wire.encode_probe_ack(
+                since > 0.0, busy_s, self.epoch)
+            try:
+                with self._ctrl_send_lock:
+                    su.send_frame(self._ctrl_sock,
+                                  su.TAG_PROBE_ACK, ack)
+            except (ConnectionError, OSError):
+                pass
+        elif tag == su.TAG_ABORT_VERDICT:
+            vname, vranks, vepoch = wire.decode_abort_verdict(
+                payload)
+            if vepoch != self.epoch:
+                return
+            with self._abort_cv:
+                self._abort_verdict = (vname, vranks)
+                self._abort_cv.notify_all()
+        elif tag == su.TAG_SERVE:
+            with self._serve_cv:
+                self._serve_inbox.append(payload)
+                self._serve_cv.notify_all()
+        elif tag == su.TAG_CLOCK_PONG:
+            # Midpoint method: offset maps this rank's monotonic
+            # axis onto rank 0's (add offset to local times).
+            t1_ns = time.monotonic_ns()
+            t0_ns, tc_ns, pepoch = wire.decode_clock_pong(payload)
+            tr = self._tracer
+            if tr is not None and pepoch == self.epoch:
+                offset_ns = tc_ns - (t0_ns + t1_ns) // 2
+                tr.clock(offset_ns, t1_ns - t0_ns)
+                # The flight recorder rides the same estimate;
+                # its dump ships the freshest value so the
+                # postmortem can align rank timelines.
+                blackbox_mod.note_clock_offset(offset_ns)
+                if self._metrics_on:
+                    _tmx.set_gauge("hvd_trace_clock_skew_seconds",
+                                   offset_ns / 1e9)
+        elif tag == su.TAG_BLACKBOX:
+            # Coordinator pulling our flight-recorder ring after
+            # an abort verdict.  Answered from THIS thread — the
+            # background thread may be the wedged party, and its
+            # evidence is exactly what the pull is for.
+            bb = blackbox_mod.get()
+            if bb is not None:
+                blob = bb.dump_bytes("coordinator_pull")
+                reply = wire.encode_blackbox_dump(
+                    self.rank, self.epoch, blob)
+                try:
+                    with self._ctrl_send_lock:
+                        su.send_frame(self._ctrl_sock,
+                                      su.TAG_BLACKBOX_DUMP, reply)
+                except (ConnectionError, OSError):
+                    pass
+
+    # -- hierarchical control tree (docs/fault_tolerance.md) -------------
+    #
+    # Children of a per-host sub-coordinator send their request/heartbeat
+    # frames over a dedicated chan-2 bootstrap link; the sub-coordinator
+    # folds everything it buffered plus its own frame into ONE
+    # TAG_TREE_UP on its direct root socket each cycle, so the root's
+    # recv work scales with hosts, not ranks.  Responses always ride the
+    # direct star — a response lost inside a dying sub-coordinator would
+    # desync the gang, so nothing irreplaceable ever transits the tree.
+
+    def _tree_parent_recv_loop(self) -> None:
+        """Child: frames forwarded down by our sub-coordinator (routed
+        probes).  EOF here is the re-parent trigger: the direct root
+        socket is still live, so adopt ourselves back to the star."""
+        sock = self._tree_parent_sock
+        try:
+            while not self._shutdown_flag.is_set():
+                tag, payload = su.recv_frame(sock)
+                self._dispatch_worker_frame(tag, payload)
+        except (ConnectionError, OSError):
+            if not (self._shutdown_flag.is_set()
+                    or self._shutdown_requested.is_set()
+                    or self._closed):
+                self._reparent_to_root()
+
+    def _tree_child_recv_loop(self, child: int,
+                              sock: socket.socket) -> None:
+        """Sub-coordinator: buffer a child's uplink frames; the next
+        worker cycle folds them into one TAG_TREE_UP.  EOF means the
+        child died — the root's heartbeat timeout owns that eviction, so
+        nothing to do here."""
+        try:
+            while not self._shutdown_flag.is_set():
+                tag, payload = su.recv_frame(sock)
+                with self._tree_up_lock:
+                    self._tree_up_buf.append((child, tag, payload))
+        except (ConnectionError, OSError):
+            pass
+
+    def _reparent_to_root(self) -> None:
+        """Child of a dead sub-coordinator: announce TAG_REPARENT on the
+        still-open direct socket and resend the recent request payloads
+        that may have died inside the parent (the coordinator's message
+        table is idempotent per rank, so duplicates are harmless).  From
+        here on this rank speaks the flat star; only the dead parent is
+        evicted — no gang-wide abort."""
+        if self._tree_orphaned or self._tree_parent is None:
+            return
+        self._tree_orphaned = True
+        old = self._tree_parent
+        self.log.warning(
+            "sub-coordinator %d unreachable; re-parenting to the root",
+            old)
+        try:
+            _fi.fire("ctrl.reparent", str(self.rank))
+            with self._ctrl_send_lock:
+                su.send_frame(self._ctrl_sock, su.TAG_REPARENT,
+                              wire.encode_reparent(self.rank, old,
+                                                   self.epoch))
+                for payload in list(self._tree_unacked):
+                    su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST,
+                                  payload)
+            self._last_send = time.monotonic()
+            _tmx.inc_counter("hvd_subcoord_reparents_total")
+            blackbox_mod.note("subcoord.reparent", time.monotonic_ns(),
+                              rank=self.rank, old_parent=old,
+                              epoch=self.epoch)
+        except (ConnectionError, OSError):
+            # The direct socket is gone too — that is a dead hub, and
+            # the ordinary lost-coordinator abort owns it.
+            self._ctrl_conn_lost = True
+            with self._serve_cv:
+                self._serve_cv.notify_all()
 
     # -- serving admission broadcast (docs/serving.md) -------------------
 
@@ -796,6 +1022,11 @@ class PyEngine(_EngineBase):
             # In-flight ops already completed on the survivors; the next
             # submission is the point where the training loop can react.
             raise RanksFailedError(self._ranks_failed)
+        if self._abort_exc is not None:
+            # Typed abort (FencedError, ...): the class IS the signal —
+            # the elastic wrapper re-forms on RanksFailedError but must
+            # let a fenced zombie exit.
+            raise self._abort_exc
         if self._aborted or self._shutdown_flag.is_set() \
                 or self._shutdown_requested.is_set():
             raise RuntimeError("horovod_tpu runtime has been shut down")
@@ -1021,6 +1252,17 @@ class PyEngine(_EngineBase):
                 self._ctrl_sock.close()
             except OSError:
                 pass
+        # Tree links (chan-2 bootstrap sockets): closing them is what
+        # unblocks the child/parent recv threads; the _closed flag above
+        # keeps the EOF from reading as a dead sub-coordinator.
+        tree_socks = list(self._tree_child_socks.values())
+        if self._tree_parent_sock is not None:
+            tree_socks.append(self._tree_parent_sock)
+        for s in tree_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
         # Closed sockets error out any sender blocked in a write; bound
         # the join so shutdown stays prompt even for a wedged thread.
         for snd in senders:
@@ -1046,6 +1288,12 @@ class PyEngine(_EngineBase):
                 dt = time.monotonic() - t0
                 _tmx.inc_counter("hvd_cycles_total")
                 _tmx.observe("hvd_cycle_duration_seconds", dt)
+                if self.rank == 0:
+                    # Root coordination cost, keyed by gang size — the
+                    # curve bench.py's ctrl_sim sweep reports (and the
+                    # number the hierarchical tree exists to flatten).
+                    _tmx.observe("hvd_ctrl_cycle_seconds", dt,
+                                 labels=(str(self.size),))
                 if dt < self.cycle_time:
                     time.sleep(self.cycle_time - dt)
         except Exception as e:  # deliver failure to all pending handles
@@ -1065,11 +1313,14 @@ class PyEngine(_EngineBase):
             self._table.clear()
             self._request_queue.clear()
             jh, self._join_handle = self._join_handle, None
+        exc = self._abort_exc
+        status = Status(StatusType.ABORTED,
+                        self._abort_reason or "Horovod has been shut down.",
+                        exc) if exc is not None else \
+            Status.aborted("Horovod has been shut down.")
         for e in entries:
             self._release_name(e.name)
-            self.handles.mark_done(
-                e.handle,
-                Status.aborted("Horovod has been shut down."), None)
+            self.handles.mark_done(e.handle, status, None)
         if jh is not None:
             self.handles.mark_done(jh, Status.ok(), None)
 
@@ -1173,38 +1424,96 @@ class PyEngine(_EngineBase):
             pass  # a dead hub surfaces through the recv loop
 
     def _worker_cycle(self, msgs: List[Request]) -> bool:
+        if self._fenced is not None:
+            # The coordinator told us we have no seat in the re-formed
+            # gang (TAG_FENCE): deliver the typed error and stop before
+            # another frame of ours can touch the new incarnation.
+            stale, current = self._fenced
+            exc = FencedError("control", stale, current)
+            self._abort(str(exc), exc=exc)
+            return False
         if self._tracer is not None:
             self._maybe_clock_ping()
         requests, hit_events = self._classify(msgs)
         want_shutdown = self._shutdown_requested.is_set()
         send_failed = False
+        # Sub-coordinator: everything the children uplinked since the
+        # last cycle folds into one TAG_TREE_UP alongside our own frame.
+        tree_entries: List[tuple] = []
+        if self._tree_child_socks:
+            with self._tree_up_lock:
+                tree_entries = self._tree_up_buf
+                self._tree_up_buf = []
         if requests or hit_events or want_shutdown:
             payload = wire.encode_request_list(requests,
                                                shutdown=want_shutdown,
                                                cache_hits=hit_events,
                                                epoch=self.epoch)
-            try:
-                _fi.fire("ctrl.worker.send", str(self.rank))
-                with self._ctrl_send_lock:
-                    su.send_frame(self._ctrl_sock, su.TAG_REQUEST_LIST,
-                                  payload)
-                self._last_send = time.monotonic()
-            except (ConnectionError, OSError):
-                # The coordinator may have closed right after
-                # broadcasting a shutdown ResponseList; the receiver
-                # thread may already hold it — drain before concluding
-                # the peer was genuinely lost.
-                send_failed = True
+            if self._tree_child_socks:
+                tree_entries.append(
+                    (self.rank, su.TAG_REQUEST_LIST, payload))
+            else:
+                try:
+                    _fi.fire("ctrl.worker.send", str(self.rank))
+                    if self._tree_parent is not None \
+                            and not self._tree_orphaned \
+                            and self._tree_parent_sock is not None:
+                        # Uplink via our host's sub-coordinator; keep the
+                        # payload so a re-parent can replay the frames a
+                        # dying parent may never have forwarded.
+                        with self._ctrl_send_lock:
+                            su.send_frame(self._tree_parent_sock,
+                                          su.TAG_REQUEST_LIST, payload)
+                        self._tree_unacked.append(payload)
+                        del self._tree_unacked[:-8]
+                    else:
+                        with self._ctrl_send_lock:
+                            su.send_frame(self._ctrl_sock,
+                                          su.TAG_REQUEST_LIST, payload)
+                    self._last_send = time.monotonic()
+                except (ConnectionError, OSError):
+                    if self._tree_parent is not None \
+                            and not self._tree_orphaned:
+                        # Dead sub-coordinator, not a dead hub: adopt
+                        # ourselves back to the star (which replays the
+                        # unacked frames, this one included).
+                        self._tree_unacked.append(payload)
+                        del self._tree_unacked[:-8]
+                        self._reparent_to_root()
+                    else:
+                        # The coordinator may have closed right after
+                        # broadcasting a shutdown ResponseList; the
+                        # receiver thread may already hold it — drain
+                        # before concluding the peer was genuinely lost.
+                        send_failed = True
         elif self.heartbeat_timeout > 0 and \
                 time.monotonic() - self._last_send >= self.heartbeat_interval:
             # Idle past the heartbeat cadence: prove liveness.  A lost
             # coordinator surfaces through the recv loop, not here.
-            try:
-                with self._ctrl_send_lock:
-                    su.send_frame(self._ctrl_sock, su.TAG_HEARTBEAT, b"")
-            except (ConnectionError, OSError):
-                pass
+            if self._tree_child_socks:
+                tree_entries.append((self.rank, su.TAG_HEARTBEAT, b""))
+            else:
+                hb_sock = self._ctrl_sock
+                if self._tree_parent is not None \
+                        and not self._tree_orphaned \
+                        and self._tree_parent_sock is not None:
+                    hb_sock = self._tree_parent_sock
+                try:
+                    with self._ctrl_send_lock:
+                        su.send_frame(hb_sock, su.TAG_HEARTBEAT, b"")
+                except (ConnectionError, OSError):
+                    if hb_sock is self._tree_parent_sock:
+                        self._reparent_to_root()
             self._last_send = time.monotonic()
+        if tree_entries:
+            up = wire.encode_tree_up(tree_entries, epoch=self.epoch)
+            try:
+                _fi.fire("ctrl.subcoord.send", str(self.rank))
+                with self._ctrl_send_lock:
+                    su.send_frame(self._ctrl_sock, su.TAG_TREE_UP, up)
+                self._last_send = time.monotonic()
+            except (ConnectionError, OSError):
+                send_failed = True
         with self._response_lock:
             inbox = self._response_inbox
             self._response_inbox = []
@@ -1336,10 +1645,25 @@ class PyEngine(_EngineBase):
                 # dead, now reconnected through a stale socket): absorbing
                 # its requests would hang or corrupt this gang's
                 # negotiation — reject the frame before it touches the
-                # message table.
+                # message table, and tell the sender WHY with a typed
+                # TAG_FENCE so it raises FencedError and exits instead
+                # of retrying forever against a gang it has no seat in.
                 self.log.warning(
                     "rejecting request frame from rank %d at epoch %d "
                     "(ours: %d)", peer, peer_epoch, self.epoch)
+                _tmx.inc_counter("hvd_fenced_writes_total")
+                blackbox_mod.note("epoch.fence", time.monotonic_ns(),
+                                  rank=peer, stale_epoch=peer_epoch,
+                                  epoch=self.epoch)
+                fsock = self._ctrl_socks.get(peer)
+                if fsock is not None:
+                    try:
+                        with self._ctrl_send_lock:
+                            su.send_frame(
+                                fsock, su.TAG_FENCE,
+                                wire.encode_fence(peer_epoch, self.epoch))
+                    except (ConnectionError, OSError):
+                        pass
                 continue
             shutdown = shutdown or peer_shutdown
             for req in reqs:
@@ -1493,6 +1817,18 @@ class PyEngine(_EngineBase):
                 continue
             if r in self._conn_lost or now - t > self.heartbeat_timeout:
                 dead.append(r)
+        # Orphan grace: a dying sub-coordinator takes its children's
+        # uplink with it, so their silence is HIS fault, not theirs.
+        # Give every rank still routed through a freshly-dead parent a
+        # full timeout window to re-parent and heartbeat directly — only
+        # the dead parent is evicted this round.
+        if dead and self._rank_route:
+            dead_set = set(dead)
+            for child, parent in list(self._rank_route.items()):
+                if parent in dead_set and child in dead_set:
+                    dead.remove(child)
+                    self._last_seen[child] = now
+                    self._conn_lost.discard(child)
         return dead
 
     def _evict_ranks(self, dead: List[int], ready: List[str]) -> None:
@@ -1616,6 +1952,22 @@ class PyEngine(_EngineBase):
 
         def _probe() -> None:
             for r in live:
+                # Ranks folded under a live sub-coordinator get their
+                # probe routed down the tree (one hop, same host); the
+                # ack always returns on the rank's DIRECT socket.  A
+                # dead or evicted parent falls back to the direct link.
+                parent = self._rank_route.get(r)
+                if parent is not None and parent in self._ctrl_socks \
+                        and parent not in self._evicted_ranks \
+                        and parent not in self._conn_lost:
+                    down = wire.encode_tree_down(r, su.TAG_PROBE, b"")
+                    try:
+                        with self._ctrl_send_lock:
+                            su.send_frame(self._ctrl_socks[parent],
+                                          su.TAG_TREE_DOWN, down)
+                        continue
+                    except (ConnectionError, OSError):
+                        pass
                 try:
                     with self._ctrl_send_lock:
                         su.send_frame(self._ctrl_socks[r],
@@ -2269,10 +2621,15 @@ class PyEngine(_EngineBase):
     def cache_stats(self) -> Dict[str, int]:
         return self._cache.stats()
 
-    def _abort(self, reason: str) -> None:
+    def _abort(self, reason: str, exc: Optional[BaseException] = None
+               ) -> None:
         self._aborted = True
         # Recorded for the elastic wrapper: a lost-coordinator abort on a
         # worker means rank 0 failed, which re-forms instead of exiting.
         self._abort_reason = reason
+        # Typed aborts (FencedError, ...) keep their class all the way
+        # to the training loop: pending handles and the next submission
+        # re-raise THIS object instead of a bare RuntimeError.
+        self._abort_exc = exc
         blackbox_mod.dump("engine_abort", reason)
         self._shutdown_flag.set()

@@ -140,6 +140,22 @@ KNOWN_METRICS: Dict[str, dict] = {
         "is promoted."),
     "hvd_nonfinite_skips_total": _counter(
         "Steps skipped by the agreed non-finite gradient guard."),
+    # -- hierarchical control plane (runtime_py.py two-level tree;
+    #    docs/fault_tolerance.md "Hierarchical control plane") --
+    "hvd_ctrl_cycle_seconds": _hist(
+        "Wall time of one root coordination cycle, labeled by gang "
+        "size — the coordination-cycle-latency-vs-ranks curve the "
+        "control-plane scale simulation (bench.py) exports.", *_SECONDS,
+        labels=("ranks",)),
+    "hvd_subcoord_reparents_total": _counter(
+        "Children of a dead per-host sub-coordinator re-attached "
+        "directly to the root (TAG_REPARENT) without a gang-wide "
+        "abort."),
+    "hvd_fenced_writes_total": _counter(
+        "Stale-epoch writes rejected by the epoch fence: control "
+        "frames answered with TAG_FENCE by the coordinator, and "
+        "elastic/* KV writes answered with HTTP 409 by the rendezvous "
+        "server."),
     # -- gang-wide tracing (telemetry/trace.py; docs/timeline.md) --
     "hvd_trace_clock_skew_seconds": _gauge(
         "Latest midpoint-method estimate of this rank's monotonic-clock "

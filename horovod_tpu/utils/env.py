@@ -105,6 +105,19 @@ ELASTIC_UID = "HVD_ELASTIC_UID"
 ELASTIC_CHECK_INTERVAL_S = "HVD_ELASTIC_CHECK_INTERVAL_S"
 ELASTIC_DISCOVERY_INTERVAL_S = "HVD_ELASTIC_DISCOVERY_INTERVAL_S"
 HOST_DISCOVERY_SCRIPT = "HVD_HOST_DISCOVERY_SCRIPT"
+# Hierarchical control plane (runtime_py.py; docs/fault_tolerance.md
+# "Hierarchical control plane, fencing, and quorum").  CTRL_FANOUT caps
+# how many children each per-host sub-coordinator folds (0 = the whole
+# host; overflow children attach directly to the root).  QUORUM gates
+# the elastic re-form majority check: with it on (default) a partition
+# minority self-terminates (PARTITION_MINORITY) instead of re-forming a
+# split-brain sibling gang.  CTRL_TREE is the tree kill-switch: the
+# control tree needs every rank speaking the Python engine's tree tags,
+# so a deliberately mixed-engine gang must set HVD_CTRL_TREE=0 to stay
+# on the flat star (single-host gangs already do, automatically).
+CTRL_FANOUT = "HVD_CTRL_FANOUT"
+CTRL_TREE = "HVD_CTRL_TREE"
+QUORUM = "HVD_QUORUM"
 # Data-plane integrity (horovod_tpu.integrity; docs/fault_tolerance.md).
 # POLICY gates the non-finite gradient guard in DistributedOptimizer
 # (off | skip | zero | raise); LIMIT is the consecutive agreed-non-finite
@@ -255,6 +268,27 @@ def collective_timeout_s() -> float:
     """Per-collective deadline in seconds; 0 (default) = no deadline,
     the seed's block-forever behavior."""
     return max(0.0, get_float(COLLECTIVE_TIMEOUT, 0.0))
+
+
+def ctrl_fanout() -> int:
+    """Children per sub-coordinator in the hierarchical control tree;
+    0 (default) = every same-host rank.  Overflow children attach
+    directly to the root."""
+    return max(0, get_int(CTRL_FANOUT, 0))
+
+
+def ctrl_tree_on() -> bool:
+    """Hierarchical control tree kill-switch (HVD_CTRL_TREE, default
+    on).  Mixed-engine gangs must turn it off: the tree tags are
+    Python-engine-only, and a native parent cannot fold its host."""
+    return get_bool(CTRL_TREE, True)
+
+
+def quorum_on() -> bool:
+    """Elastic re-form majority gate (HVD_QUORUM, default on): re-form
+    only when a strict majority of the last-committed membership is
+    reachable; a minority self-terminates instead of split-braining."""
+    return get_bool(QUORUM, True)
 
 
 def serve_port() -> int:

@@ -72,6 +72,21 @@ TAG_CLOCK_PONG = 15     # coordinator -> worker: echo + coord clock
 # codecs: common/wire.py; values reserved in csrc/wire.h.
 TAG_BLACKBOX = 16       # coordinator -> worker: send me your ring
 TAG_BLACKBOX_DUMP = 17  # worker -> coordinator: serialized ring dump
+# Hierarchical control tree (Python engine only, multi-host gangs;
+# runtime_py.py "two-level control plane", docs/fault_tolerance.md
+# "Hierarchical control plane, fencing, and quorum").  One
+# sub-coordinator per host folds its children's request/heartbeat
+# frames into a single TAG_TREE_UP aggregate; the root routes probes
+# down through TAG_TREE_DOWN; an orphaned child of a dead
+# sub-coordinator adopts itself back to the root with TAG_REPARENT
+# over its still-live bootstrap-time control link.  TAG_FENCE is the
+# coordinator's typed rejection of a stale-epoch sender (the zombie
+# exits with FencedError instead of corrupting the re-formed gang).
+# Payload codecs: common/wire.py; values reserved in csrc/wire.h.
+TAG_TREE_UP = 18        # sub-coordinator -> root: aggregated child frames
+TAG_TREE_DOWN = 19      # root -> sub-coordinator: routed/broadcast frame
+TAG_REPARENT = 20       # orphaned child -> root: adopt me directly
+TAG_FENCE = 21          # coordinator -> stale-epoch sender: epoch fenced
 
 
 def send_frame(sock: socket.socket, tag: int, payload: bytes) -> None:

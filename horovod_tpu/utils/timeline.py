@@ -57,6 +57,18 @@ COLLECTIVE_ABORT = "COLLECTIVE_ABORT"
 HOP_RETRY = "HOP_RETRY"
 TRANSPORT_FAILOVER = "TRANSPORT_FAILOVER"
 
+# Hierarchical-control-plane records (runtime_py.py, elastic/run.py;
+# docs/fault_tolerance.md "Hierarchical control plane, fencing, and
+# quorum").  SUBCOORD_REPARENT = a child of a dead per-host
+# sub-coordinator re-attached directly to the root (args name the child
+# and the dead parent) — failure isolation working: only the dead rank
+# is evicted, no gang-wide abort.  PARTITION_MINORITY = this side of a
+# membership split holds no strict majority of the last-committed
+# roster, so it self-terminates instead of re-forming a split-brain
+# sibling gang.
+SUBCOORD_REPARENT = "SUBCOORD_REPARENT"
+PARTITION_MINORITY = "PARTITION_MINORITY"
+
 # Telemetry records (horovod_tpu.telemetry; docs/metrics.md).
 STRAGGLER = "STRAGGLER"
 

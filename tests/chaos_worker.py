@@ -102,6 +102,86 @@ def scenario_metrics_scrape(hvd, fi):
     print(f"SCRAPE_OK {hvd.rank()}", flush=True)
 
 
+def scenario_tree_subcoord_steps(hvd, fi):
+    """train_steps on a multi-host (block-topology) gang with the
+    hierarchical control tree on, instrumented for the failure-isolation
+    contract (docs/fault_tolerance.md): when a sub-coordinator dies, its
+    children re-parent to the root instead of being dragged down with
+    it.  Every survivor prints its tree view after the expected
+    RanksFailedError so the driving test can assert who re-parented,
+    who got evicted, and that the reparent landed in the blackbox."""
+    from horovod_tpu import basics
+    from horovod_tpu.telemetry import blackbox as bb
+
+    rank = hvd.rank()
+    step = -1
+    try:
+        for step in range(STEPS):
+            out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                name=f"tree.step{step}")
+            print(f"STEP {step} {float(out[0])}", flush=True)
+            fi.fire("train.step", str(step))
+        print("NO_FAILURE", flush=True)
+        os._exit(3)
+    except hvd.RanksFailedError as e:
+        print(f"RANKS_FAILED {json.dumps(e.ranks)} at_step {step}",
+              flush=True)
+        eng = basics._runtime
+        snap = bb.get().snapshot() if bb.active() else {}
+        reparent_noted = any(
+            ev.get("kind") == "subcoord.reparent"
+            for ev in snap.get("events", []))
+        print(f"TREE rank={rank} parent={eng._tree_parent} "
+              f"orphaned={eng._tree_orphaned} "
+              f"reparented={sorted(eng._reparented_ranks)} "
+              f"bb_reparent={reparent_noted}", flush=True)
+        os._exit(0)
+    except RuntimeError as e:
+        # The rank whose own control path failed (an injected
+        # ctrl.subcoord.send / ctrl.reparent wire error): the engine
+        # aborts as a lost coordinator and the blocked allreduce raises.
+        print(f"CTRL_LOST {rank}: {e}", flush=True)
+        os._exit(17)
+
+
+def scenario_fence_stale_epoch(hvd, fi):
+    """PR-15 zombie-writer window, control-plane half: rank 1 boots
+    believing a stale elastic epoch (the driving test skews
+    HVD_ELASTIC_EPOCH); its first negotiation frame draws TAG_FENCE
+    from the newer-epoch coordinator, the submitted allreduce raises
+    the *typed* FencedError, and the zombie exits — the coordinator
+    evicts it on heartbeat silence without a gang-wide abort."""
+    from horovod_tpu.common.types import FencedError
+    from horovod_tpu.telemetry import blackbox as bb
+
+    def _fences():
+        snap = bb.get().snapshot() if bb.active() else {}
+        return sum(1 for ev in snap.get("events", [])
+                   if ev.get("kind") == "epoch.fence")
+
+    rank = hvd.rank()
+    try:
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                            name="fence.step")
+        # Only the up-to-date coordinator gets here: it fences the
+        # zombie's frame, evicts it on heartbeat silence, and completes
+        # the in-flight collective over the survivor group (itself).
+        # The zombie completing would mean the fence never fired —
+        # zero epoch.fence events in its blackbox betrays that as 3.
+        n = _fences()
+        print(f"SURVIVED rank={rank} sum={float(out[0])} fences={n}",
+              flush=True)
+        os._exit(0 if n else 3)
+    except FencedError as e:
+        print(f"FENCED rank={rank} stale={e.stale_epoch} "
+              f"current={e.current_epoch}", flush=True)
+        os._exit(0)
+    except hvd.RanksFailedError as e:
+        print(f"RANKS_FAILED {json.dumps(e.ranks)} "
+              f"fences={_fences()}", flush=True)
+        os._exit(0)
+
+
 def scenario_straggler(hvd, fi):
     """Straggler detection end-to-end: the driving test delays rank 1's
     control sends, so the coordinator sees rank 1 consistently last.
@@ -119,6 +199,8 @@ def scenario_straggler(hvd, fi):
 SCENARIOS = {
     "bootstrap_allreduce": scenario_bootstrap_allreduce,
     "train_steps": scenario_train_steps,
+    "tree_subcoord_steps": scenario_tree_subcoord_steps,
+    "fence_stale_epoch": scenario_fence_stale_epoch,
     "metrics_scrape": scenario_metrics_scrape,
     "straggler": scenario_straggler,
 }

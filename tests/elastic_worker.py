@@ -62,6 +62,31 @@ def main():
                 time.sleep(step_sleep)
 
     train(state)
+    if os.environ.get("ELASTIC_CACHE_PROBE") == "1":
+        # Response-cache consistency probe (driven by
+        # test_elastic_response_cache_consistent_after_reform): submit
+        # the same tensor names twice so the second pass runs through
+        # the cache-hit protocol of the POST-re-form engine, then print
+        # this rank's cache view.  Every member of the re-formed gang —
+        # survivors that carried state through the reset and a joiner
+        # that started cold — must print identical positions, or the
+        # hit-bit exchange would be addressing different responses.
+        import json
+
+        from horovod_tpu import basics
+
+        names = [f"cache.warm{i}" for i in range(4)]
+        for _ in range(2):
+            for n in names:
+                out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                                    name=n)
+                assert float(out[0]) == hvd.size(), (n, out)
+        cache = basics._runtime._cache
+        view = {"positions": sorted(
+                    [n, cache.position_of(n)] for n in names),
+                "len": len(cache),
+                "hits": cache.stats()["hits"]}
+        print(f"CACHE {json.dumps(view)}", flush=True)
     # Persistent-sender hygiene across elastic re-forms: each re-formed
     # mesh tears down the old pool, so at most size-1 hvd-send-* threads
     # exist now, and zero survive shutdown (docs/performance.md).

@@ -1,7 +1,8 @@
 """Bench regression gate as a test: the newest ``BENCH_r*`` snapshot
 must not drop any shared ``*_per_sec`` metric — nor raise any shared
-``*_p99_ms`` / ``*_p50_ms`` latency percentile — by more than 20% vs
-the previous round (tools/check_bench_regression.py)."""
+``*_p99_ms`` / ``*_p50_ms`` latency percentile, nor the control-plane
+``coordination_cycle_p50_us`` scale proof — by more than 20% vs the
+previous round (tools/check_bench_regression.py)."""
 
 import json
 import sys
@@ -50,6 +51,29 @@ def test_latency_within_tolerance_passes(tmp_path):
     _write(tmp_path, 2, {"serve_p99_ms": 11.9, "serve_p50_ms": 1.2})
     # +19% p99 is inside the 20% tolerance; a latency IMPROVEMENT of
     # any size never trips the gate (it is one-sided, like throughput).
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_coordination_cycle_gate_is_one_sided(tmp_path):
+    # The control-plane scale proof (ctrl_sim's hierarchical 256-rank
+    # cycle p50) gates like a latency percentile despite its _us unit:
+    # a >20% rise trips, any improvement passes.
+    _write(tmp_path, 1, {"coordination_cycle_p50_us": 1000.0})
+    _write(tmp_path, 2, {"coordination_cycle_p50_us": 1300.0})
+    problems = cbr.check(root=tmp_path)
+    assert len(problems) == 1, problems
+    assert "coordination_cycle_p50_us" in problems[0]
+    assert "rose 30.0%" in problems[0]
+    _write(tmp_path, 2, {"coordination_cycle_p50_us": 400.0})
+    assert cbr.check(root=tmp_path) == []
+
+
+def test_per_size_ctrl_cycle_keys_stay_informational(tmp_path):
+    # Only the headline key gates; the per-size/per-mode curve keys
+    # (ctrl_cycle_star_p50_us_256, ...) are informational — they do not
+    # match the _p50_ms/_p99_ms suffixes and are not the headline.
+    _write(tmp_path, 1, {"ctrl_cycle_star_p50_us_256": 100.0})
+    _write(tmp_path, 2, {"ctrl_cycle_star_p50_us_256": 900.0})
     assert cbr.check(root=tmp_path) == []
 
 

@@ -106,6 +106,40 @@ def test_fault_delay_sleeps_without_raising():
     assert time.monotonic() - t0 < 0.04
 
 
+def test_fault_partition_cuts_only_cross_group_frames(monkeypatch):
+    """``kind: partition`` severs frames CROSSING the two rank groups,
+    both directions, while same-side traffic flows — a network
+    partition between host groups, not a single dead link."""
+    monkeypatch.setenv("HVD_RANK", "3")
+    fi.configure({"faults": [
+        {"site": "sock.send", "kind": "partition",
+         "groups": [[0, 1, 2], [3, 4, 5]]}]})
+    fi.fire("sock.send", "4")          # same side: flows
+    fi.fire("sock.send", "req")        # non-rank detail: not peer-addressed
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("sock.send", "0")      # crosses the cut
+    with pytest.raises(fi.InjectedFault):
+        # Sites that pass the sender's own rank are talking to the
+        # root: rank 0 stands in as the remote, and 3->0 crosses.
+        fi.fire("sock.send", "3")
+    # Same-side and non-rank passes must not consume bookkeeping.
+    fi.configure({"faults": [
+        {"site": "sock.send", "kind": "partition", "times": 1,
+         "groups": [[0], [3]]}]})
+    fi.fire("sock.send", "4")          # 4 is in neither group: flows
+    with pytest.raises(fi.InjectedFault):
+        fi.fire("sock.send", "0")
+    fi.fire("sock.send", "0")          # times exhausted: healed
+
+
+def test_fault_partition_requires_two_rank_groups():
+    for bad in ({}, {"groups": [[0, 1]]}, {"groups": "0,1"},
+                {"groups": [[0], [1], [2]]}):
+        with pytest.raises(ValueError, match="partition fault needs"):
+            fi.configure({"faults": [
+                dict({"site": "s", "kind": "partition"}, **bad)]})
+
+
 def test_plan_env_loading_inline_and_file(tmp_path, monkeypatch):
     monkeypatch.setenv(fi.ENV_VAR,
                        '{"faults": [{"site": "x", "kind": "error"}]}')
@@ -255,6 +289,7 @@ def test_check_dead_ranks_semantics():
     eng.heartbeat_timeout = 0.0
     eng._evicted_ranks = set()
     eng._conn_lost = set()
+    eng._rank_route = {}
     eng._last_seen = {1: now - 99.0, 2: now}
     assert eng._check_dead_ranks() == []  # disabled by default
     eng.heartbeat_timeout = 1.0
@@ -263,6 +298,17 @@ def test_check_dead_ranks_semantics():
     assert sorted(eng._check_dead_ranks()) == [1, 2]  # EOF beats timer
     eng._evicted_ranks.add(1)
     assert eng._check_dead_ranks() == [2]         # evict only once
+
+    # Orphan grace: a child routed through a dead sub-coordinator is
+    # spared this round (silence is the parent's fault) and its clock
+    # resets so it gets a full window to re-parent.
+    eng._evicted_ranks.clear()
+    eng._conn_lost.clear()
+    eng._rank_route = {2: 1}
+    eng._last_seen = {1: now - 99.0, 2: now - 99.0}
+    assert eng._check_dead_ranks() == [1]         # parent only
+    assert eng._last_seen[2] > now - 1.0          # child clock reset
+    assert eng._check_dead_ranks() == [1]         # child stays spared
 
 
 def test_ranks_failed_error_exported():
@@ -280,14 +326,21 @@ def test_ranks_failed_error_exported():
 
 
 def run_chaos(scenario, np_, *, base_env=None, rank_env=None,
-              timeout=120.0):
+              timeout=120.0, local_size=None):
     """Spawn an np_-rank gang of chaos_worker.py on the loopback mesh
     (PyEngine on every rank — EVICT is a PyEngine extension) and return
     per-rank (exit_code, stdout, stderr).  Exit codes are asserted by the
-    caller: chaos gangs *expect* some ranks to die."""
+    caller: chaos gangs *expect* some ranks to die.
+
+    ``local_size`` simulates a multi-node block topology (rank =
+    cross_rank*local_size + local_rank, like test_multiprocess) — the
+    shape that turns the hierarchical control tree on.  Default: one
+    node containing all ranks."""
     server = RendezvousServer("127.0.0.1")
     port = server.start()
     procs = []
+    ls = local_size or np_
+    assert np_ % ls == 0
     try:
         for rank in range(np_):
             env = dict(os.environ)
@@ -297,10 +350,10 @@ def run_chaos(scenario, np_, *, base_env=None, rank_env=None,
             env.update({
                 "HVD_RANK": str(rank),
                 "HVD_SIZE": str(np_),
-                "HVD_LOCAL_RANK": str(rank),
-                "HVD_LOCAL_SIZE": str(np_),
-                "HVD_CROSS_RANK": "0",
-                "HVD_CROSS_SIZE": "1",
+                "HVD_LOCAL_RANK": str(rank % ls),
+                "HVD_LOCAL_SIZE": str(ls),
+                "HVD_CROSS_RANK": str(rank // ls),
+                "HVD_CROSS_SIZE": str(np_ // ls),
                 "HVD_RENDEZVOUS_ADDR": "127.0.0.1",
                 "HVD_RENDEZVOUS_PORT": str(port),
                 "JAX_PLATFORMS": "cpu",

@@ -438,3 +438,63 @@ def test_cli_elastic_flag_validation(tmp_path):
     res = _run_cli("-np", "2", "--min-np", "1", "--launcher", "jsrun")
     assert res.returncode == 2 and "not supported with --launcher" \
         in res.stderr, res.stderr
+
+
+def _cache_view(out):
+    m = re.search(r"CACHE (\{.*\})", out)
+    assert m, out
+    return json.loads(m.group(1))
+
+
+def test_elastic_response_cache_survivors_agree_after_reform():
+    """Response-cache consistency across a failure re-form (satellite of
+    the hierarchical-control-plane PR): the re-formed engine starts the
+    cache cold on EVERY survivor — positions are renegotiated, and the
+    post-re-form hit-bit exchange addresses the same responses on both.
+    The probe warms four names twice after training; identical views +
+    nonzero hits prove the cache protocol re-converged rather than one
+    rank replaying positions from the dead incarnation."""
+    plan = json.dumps({"faults": [
+        {"site": "train.step", "kind": "kill", "after": 3}]})
+    outs = run_elastic(
+        3, min_np=2, max_np=3,
+        base_env={"ELASTIC_TOTAL_STEPS": "8",
+                  "ELASTIC_COMMIT_EVERY": "3",
+                  "ELASTIC_CACHE_PROBE": "1"},
+        rank_env={2: {fi.ENV_VAR: plan}})
+
+    assert outs[2][0] == 137, outs[2]
+    views = []
+    for rank in (0, 1):
+        code, out, err = outs[rank]
+        assert code == 0, (rank, out, err)
+        assert "RESET size 2" in out, out
+        views.append(_cache_view(out))
+    assert views[0] == views[1], views
+    assert views[0]["len"] >= 4, views
+    assert all(pos >= 0 for _, pos in views[0]["positions"]), views
+    assert views[0]["hits"] >= 4, views   # the second pass hit
+
+
+def test_elastic_response_cache_joiner_starts_cold_in_sync():
+    """The joiner half: a late worker admitted into a grown gang holds
+    no cache from before its epoch, yet after the probe its positions
+    match the incumbents' exactly — a cold start re-converges instead
+    of desyncing the hit bits."""
+    outs = run_elastic(
+        2, min_np=1, max_np=3,
+        base_env={"ELASTIC_TOTAL_STEPS": "400",
+                  "ELASTIC_COMMIT_EVERY": "1",
+                  "ELASTIC_STEP_SLEEP": "0.05",
+                  "ELASTIC_STOP_AT_SIZE": "3",
+                  "ELASTIC_STEPS_AFTER_GROW": "3",
+                  "ELASTIC_CACHE_PROBE": "1"},
+        joiner_delay=1.0)
+
+    views = []
+    for i, (code, out, err) in enumerate(outs):
+        assert code == 0, (i, out, err)
+        views.append(_cache_view(out))
+    assert views[-1] == views[0], views       # joiner == incumbent
+    assert all(v == views[0] for v in views), views
+    assert views[0]["hits"] >= 4, views

@@ -206,3 +206,79 @@ def test_mirror_failure_does_not_break_primary():
         assert c.get_bytes("k") == b"v"
     finally:
         primary.stop()
+
+
+# -- epoch fencing (docs/fault_tolerance.md "Epoch fencing") ------------
+
+
+def test_kv_epoch_fencing_stale_write_409_typed(monkeypatch):
+    """A zombie's stale-epoch write under an elastic/* scope draws HTTP
+    409 and surfaces as the typed FencedError; the re-formed gang's
+    value is untouched."""
+    from horovod_tpu.common.types import FencedError
+
+    srv = RendezvousServer(host="127.0.0.1")
+    srv.start()
+    try:
+        c = KVClient("127.0.0.1", srv.port)
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "2")
+        c.put("job0/elastic/roster", b"new-gang")
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "1")
+        with pytest.raises(FencedError) as ei:
+            c.put("job0/elastic/roster", b"zombie")
+        assert ei.value.stale_epoch == 1
+        assert ei.value.current_epoch == 2
+        with pytest.raises(FencedError):
+            c.delete("job0/elastic/roster")
+        assert c.get_bytes("job0/elastic/roster") == b"new-gang"
+        # Reads never fence; a zombie may still pull a postmortem.
+        assert c.get("job0/elastic/roster") == "new-gang"
+    finally:
+        srv.stop()
+
+
+def test_kv_epoch_fencing_scoped_and_opt_in(monkeypatch):
+    """The fence keys off the prefix before ``elastic/`` — independent
+    jobs don't fence each other — and only epoch-stamped writers under
+    elastic scopes participate at all."""
+    srv = RendezvousServer(host="127.0.0.1")
+    srv.start()
+    try:
+        c = KVClient("127.0.0.1", srv.port)
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "5")
+        c.put("jobA/elastic/x", b"a")
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "1")
+        c.put("jobB/elastic/x", b"b")     # other scope: no fence
+        c.put("plain/key", b"c")          # non-elastic: never fences
+        monkeypatch.delenv(env_util.ELASTIC_EPOCH)
+        c.put("jobA/other", b"d")         # no epoch stamped: no fence
+        assert c.get_bytes("jobB/elastic/x") == b"b"
+        assert c.get_bytes("plain/key") == b"c"
+    finally:
+        srv.stop()
+
+
+def test_kv_epoch_fencing_mirrors_fence_identically(monkeypatch):
+    """The epoch header forwards with every mirror write, so a standby
+    promoted after a failover rejects the same zombies the primary
+    would have."""
+    from horovod_tpu.common.types import FencedError
+
+    primary = RendezvousServer(host="127.0.0.1", secret="sF")
+    primary.start()
+    standby = RendezvousServer(host="127.0.0.1", secret="sF")
+    standby.start()
+    try:
+        primary.set_mirrors([("127.0.0.1", standby.port)])
+        c = KVClient("127.0.0.1", primary.port, secret="sF")
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "3")
+        c.put("job/elastic/roster", b"epoch3")
+        # Zombie talks straight to the (promoted) standby.
+        zc = KVClient("127.0.0.1", standby.port, secret="sF")
+        monkeypatch.setenv(env_util.ELASTIC_EPOCH, "2")
+        with pytest.raises(FencedError):
+            zc.put("job/elastic/roster", b"zombie")
+        assert zc.get_bytes("job/elastic/roster") == b"epoch3"
+    finally:
+        primary.stop()
+        standby.stop()

@@ -120,6 +120,28 @@ def test_cli_metrics_port_validated_at_parse_time(capsys):
             ["-np", "1", "--metrics-port", "abc", "python", "-c", "pass"])
 
 
+def test_cli_ctrl_fanout_env_mapping():
+    args = make_parser().parse_args([
+        "-np", "2", "--ctrl-fanout", "4", "python", "x.py"])
+    env = config_parser.env_from_args(args)
+    assert env["HVD_CTRL_FANOUT"] == "4"
+
+
+def test_cli_ctrl_fanout_validated_at_parse_time(capsys):
+    # A negative fanout is an actionable exit-2 before any worker
+    # spawns (0 = fold the whole host; see docs/fault_tolerance.md).
+    from horovod_tpu.runner import run as run_mod
+
+    rc = run_mod.run_commandline(
+        ["-np", "1", "--ctrl-fanout", "-3", "python", "-c", "pass"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "--ctrl-fanout" in err and ">= 0" in err, err
+    with pytest.raises(SystemExit):  # argparse rejects non-integers
+        run_mod.run_commandline(
+            ["-np", "1", "--ctrl-fanout", "abc", "python", "-c", "pass"])
+
+
 def test_config_file(tmp_path):
     p = tmp_path / "cfg.yaml"
     p.write_text("fusion-threshold-mb: 16\ncycle-time-ms: 2\n")

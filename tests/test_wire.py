@@ -127,3 +127,32 @@ def test_epoch_trailer_missing_defaults_to_zero():
     full = wire.encode_response_list([], hit_positions=[2], epoch=5)
     _, _, _, _, _, epoch = wire.decode_response_list(full[:-4])
     assert epoch == 0
+
+
+def test_tree_up_roundtrip_is_tag_transparent():
+    # A sub-coordinator folds whatever its children sent — request
+    # lists, heartbeats, empty payloads — without decoding any of it.
+    inner = wire.encode_request_list(
+        [Request(request_rank=4, tensor_name="grad_0")], epoch=3)
+    entries = [(4, 1, inner), (5, 5, b""), (3, 8, b"\x01busy")]
+    out, epoch = wire.decode_tree_up(
+        wire.encode_tree_up(entries, epoch=3))
+    assert out == entries and epoch == 3
+    reqs, _, _, e = wire.decode_request_list(out[0][2])
+    assert reqs[0].tensor_name == "grad_0" and e == 3
+    assert wire.decode_tree_up(wire.encode_tree_up([])) == ([], 0)
+
+
+def test_tree_down_roundtrip_and_broadcast_target():
+    target, tag, payload = wire.decode_tree_down(
+        wire.encode_tree_down(7, 7, b"probe-payload"))
+    assert (target, tag, payload) == (7, 7, b"probe-payload")
+    # -1 fans the frame to every child on the host.
+    target, _, _ = wire.decode_tree_down(wire.encode_tree_down(-1, 7, b""))
+    assert target == -1
+
+
+def test_reparent_and_fence_roundtrip():
+    assert wire.decode_reparent(wire.encode_reparent(5, 3, epoch=2)) \
+        == (5, 3, 2)
+    assert wire.decode_fence(wire.encode_fence(1, 4)) == (1, 4)

@@ -5,8 +5,11 @@ Compares the two most recent ``BENCH_r*.json`` snapshots at the repo
 root (ordered by round number) and fails when any **shared** throughput
 metric — a key ending in ``_per_sec`` — dropped by more than the
 tolerance (default 20%), or any shared tail/median latency metric — a
-key ending in ``_p99_ms`` / ``_p50_ms`` — rose by more than the same
-tolerance.  Other ``*_ms`` keys (plain means, durations) stay
+key ending in ``_p99_ms`` / ``_p50_ms``, plus the control-plane
+``coordination_cycle_p50_us`` scale proof (horovod_tpu/ctrl_sim, the
+hierarchical tree's 256-rank cycle p50) — rose by more than the same
+tolerance.  All latency gates are one-sided: getting faster never
+trips.  Other ``*_ms`` keys (plain means, durations) stay
 informational: they are noisy in CI and direction-ambiguous across
 workload changes, but a percentile that moves 20%+ is a real serving
 regression.
@@ -127,7 +130,8 @@ def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
     # Latency gate: shared percentile metrics must not RISE past the
     # tolerance (higher = worse, the mirror image of throughput).
     lat = {k for k in set(old) & set(new)
-           if k.endswith(("_p99_ms", "_p50_ms"))}
+           if k.endswith(("_p99_ms", "_p50_ms"))
+           or k == "coordination_cycle_p50_us"}
     for k in sorted(lat):
         if old[k] <= 0:
             continue
