@@ -476,9 +476,11 @@ def main() -> None:
             t0 = time.perf_counter()
             deng.step()
             lats.append((time.perf_counter() - t0) * 1e3)
+        from horovod_tpu.telemetry.registry import quantile as _quantile
+
         for q in (50, 90, 99):
             extras[f"decode_token_latency_p{q}_ms"] = round(
-                float(np.percentile(lats, q)), 3)
+                _quantile(lats, q / 100.0), 3)
     except Exception as e:
         extras["decode_latency_error"] = f"{type(e).__name__}: {e}"[:200]
 
@@ -539,10 +541,12 @@ def main() -> None:
         sthread.join(30)
         extras["serve_tokens_per_sec"] = round(
             n_clients * reqs_each * snew / wall, 1)
+        from horovod_tpu.telemetry.registry import quantile as _quantile
+
         extras["serve_ttft_p50_ms"] = round(
-            float(np.percentile(ttft_ms, 50)), 2)
+            _quantile(ttft_ms, 0.50), 2)
         extras["serve_p99_ms"] = round(
-            float(np.percentile(lat_ms, 99)), 2)
+            _quantile(lat_ms, 0.99), 2)
     except Exception as e:
         extras["serve_bench_error"] = f"{type(e).__name__}: {e}"[:200]
 
@@ -613,6 +617,43 @@ def main() -> None:
             extras["trace_num_collectives"] = rep["num_collectives"]
     except Exception as e:
         extras["trace_bench_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- gang aggregation cost: one fold over an 8-rank gang ------------
+    # The coordinator-side GangAggregator fold (telemetry/aggregate.py)
+    # runs every HVD_AGG_INTERVAL on rank 0 next to training, so its
+    # cost is itself a gated number: 8 synthetic per-rank snapshots with
+    # realistic histogram/counter density, folded repeatedly; headline
+    # ``gang_agg_fold_p50_us`` is the median fold wall time
+    # (one-sided gate in tools/check_bench_regression.py).
+    try:
+        from horovod_tpu.telemetry import aggregate as _agg_mod
+        from horovod_tpu.telemetry import registry as _reg_mod
+
+        agg_snaps = {}
+        for r in range(8):
+            reg = _reg_mod.Registry()
+            for i in range(200):
+                reg.observe("hvd_collective_latency_seconds",
+                            0.001 * (1 + (i + r) % 7),
+                            labels=("allreduce", "float32"))
+                reg.observe("hvd_ring_hop_seconds",
+                            0.0005 * (1 + (i * (r + 1)) % 5),
+                            labels=("recv",))
+            reg.inc_counter("hvd_collectives_total", 200,
+                            labels=("allreduce", "float32"))
+            reg.inc_counter("hvd_transport_bytes_total", 1 << 24,
+                            labels=("shm",))
+            reg.set_gauge("hvd_queue_depth", r)
+            agg_snaps[r] = {"rank": r, **reg.snapshot()}
+        fold_us = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            _agg_mod.fold(agg_snaps)
+            fold_us.append((time.perf_counter() - t0) * 1e6)
+        extras["gang_agg_fold_p50_us"] = round(
+            _reg_mod.quantile(fold_us, 0.5), 1)
+    except Exception as e:
+        extras["agg_bench_error"] = f"{type(e).__name__}: {e}"[:200]
 
     # --- control-plane scale: coordination-cycle latency vs ranks -------
     # 8/64/256 in-process ranks over socketpairs (horovod_tpu/ctrl_sim),

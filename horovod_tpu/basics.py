@@ -99,7 +99,7 @@ def init(
         # eager-layer collective metrics work under either core.
         from horovod_tpu import telemetry
 
-        telemetry.init_from_env(r, lr or 0)
+        telemetry.init_from_env(r, lr or 0, size=s)
 
 
 def _make_engine(r, s, lr, ls, cr, cs):

@@ -89,6 +89,8 @@ KNOWN_SITES = {
     "kv.server.request": "rendezvous server request handling",
     "kv.mirror": "rendezvous primary->standby write-through mirroring",
     "metrics.server.request": "metrics debug-server request handling",
+    "agg.scrape": "gang aggregator per-rank snapshot read (KV entry + "
+                  "HTTP scrape fallback; detail = the rank)",
     "bootstrap.start": "worker bootstrap entry",
     "bootstrap.accept": "mesh listener accept loop",
     "engine.cycle": "PyEngine background cycle",

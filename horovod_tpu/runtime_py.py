@@ -412,7 +412,8 @@ class PyEngine(_EngineBase):
         # arguments allocate guard on this flag.  The straggler detector
         # is coordinator-only: it folds the per-rank ready ticks the
         # coordinator already sees into a skew histogram.
-        self._metrics_on = _telemetry.init_from_env(rank, local_rank)
+        self._metrics_on = _telemetry.init_from_env(rank, local_rank,
+                                                    size=size)
         self._straggler = None
         if self._metrics_on:
             _tmx.set_gauge("hvd_elastic_epoch", self.epoch)

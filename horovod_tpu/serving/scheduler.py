@@ -268,4 +268,17 @@ class Scheduler:
                        out["last_step_age_s"])
         _tmx.set_gauge("hvd_serve_oldest_queued_age_seconds",
                        out["oldest_queued_age_s"])
+        # SLO rollups from the registry's serve histograms (the same
+        # bucket math the gang aggregator uses), when telemetry is on.
+        if _tmx.enabled():
+            hists = _tmx.snapshot().get("histograms", {})
+            for metric, key in (("hvd_serve_ttft_seconds", "ttft"),
+                                ("hvd_serve_token_latency_seconds",
+                                 "step")):
+                h = hists.get(metric)
+                if h and h.get("count"):
+                    out[f"{key}_p50_ms"] = round(
+                        1e3 * _tmx.histogram_quantile(h, 0.50), 3)
+                    out[f"{key}_p99_ms"] = round(
+                        1e3 * _tmx.histogram_quantile(h, 0.99), 3)
         return out

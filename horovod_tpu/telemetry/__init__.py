@@ -23,15 +23,18 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from horovod_tpu.telemetry import aggregate as _agg_mod
 from horovod_tpu.telemetry import flush as _flush_mod
 from horovod_tpu.telemetry import registry
 from horovod_tpu.telemetry import server as _server_mod
 from horovod_tpu.telemetry.registry import (  # noqa: F401
     KNOWN_METRICS,
     enabled,
+    histogram_quantile,
     inc_counter,
     known_metrics,
     observe,
+    quantile,
     render_prometheus,
     set_gauge,
     snapshot,
@@ -50,10 +53,12 @@ def enabled_in_env() -> bool:
             or bool(env_util.get_str(env_util.METRICS_FILE)))
 
 
-def init_from_env(rank: int, local_rank: int = 0) -> bool:
+def init_from_env(rank: int, local_rank: int = 0, size: int = 1) -> bool:
     """Engine-construction hook: turn the registry on and start the
-    debug server / flusher per the env.  Idempotent — an elastic
-    re-form re-enters here with the server already up."""
+    debug server / flusher per the env — plus, on rank 0, the gang
+    aggregator that folds every rank's snapshot into the single gang
+    view (``/gang/metrics*``).  Idempotent — an elastic re-form
+    re-enters here with the server already up."""
     global _server, _flusher
     if not enabled_in_env():
         return False
@@ -63,14 +68,21 @@ def init_from_env(rank: int, local_rank: int = 0) -> bool:
             port = env_util.get_int(env_util.METRICS_PORT, 0)
             if port > 0:
                 _server = _server_mod.maybe_start(port, local_rank)
+        kv = _flush_mod.kv_from_env()
         if _flusher is None:
             path = env_util.get_str(env_util.METRICS_FILE)
             interval = env_util.get_float(env_util.METRICS_INTERVAL, 10.0)
-            kv = _flush_mod.kv_from_env()
             if path or kv is not None:
+                scrape = ""
+                if _server is not None:
+                    scrape = f"127.0.0.1:{_server.port}"
                 _flusher = _flush_mod.Flusher(
-                    rank, path=path, interval_s=interval, kv=kv)
+                    rank, path=path, interval_s=interval, kv=kv,
+                    scrape=scrape,
+                    epoch=env_util.get_int(env_util.ELASTIC_EPOCH, 0))
                 _flusher.start()
+        if rank == 0 and size > 1 and kv is not None:
+            _agg_mod.start_from_env(size, kv=kv)
     return True
 
 
@@ -81,6 +93,7 @@ def stop() -> None:
     with _lock:
         srv, fl = _server, _flusher
         _server, _flusher = None, None
+    _agg_mod.stop()
     if fl is not None:
         fl.stop()
     if srv is not None:

@@ -30,11 +30,18 @@ log = logging.getLogger("horovod_tpu.telemetry")
 
 class Flusher:
     def __init__(self, rank: int, path: str = "",
-                 interval_s: float = 10.0, kv=None):
+                 interval_s: float = 10.0, kv=None,
+                 scrape: str = "", epoch: int = 0):
         self.rank = rank
         self.path = path
         self.interval_s = max(0.1, interval_s)
         self.kv = kv  # KVClient or None
+        # Stamped on every record: the rank's own debug-server address
+        # (the gang aggregator's direct-scrape fallback when the KV
+        # entry goes missing) and the elastic epoch (so the aggregator
+        # rejects a pre-re-form incarnation's numbers as stale).
+        self.scrape = scrape
+        self.epoch = int(epoch)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._warned = set()
@@ -44,7 +51,10 @@ class Flusher:
         snap = _reg.snapshot()
         if not snap:
             return None
-        record = {"rank": self.rank, "seq": self._seq, **snap}
+        record = {"rank": self.rank, "seq": self._seq,
+                  "epoch": self.epoch, **snap}
+        if self.scrape:
+            record["scrape"] = self.scrape
         self._seq += 1
         if self.path:
             try:

@@ -195,6 +195,18 @@ KNOWN_METRICS: Dict[str, dict] = {
     # -- flight recorder (telemetry/blackbox.py; docs/fault_tolerance.md) --
     "hvd_blackbox_dumps_total": _counter(
         "Flight-recorder dumps written at terminal failures."),
+    # -- gang aggregation & alerts (telemetry/aggregate.py) --
+    "hvd_alerts_total": _counter(
+        "Anomaly-engine alerts fired (rising edges), by rule.",
+        ("rule",)),
+    "hvd_gang_agg_fold_seconds": _hist(
+        "Wall time of one gang aggregation fold on the coordinator "
+        "(read every rank's snapshot, merge, evaluate alert rules).",
+        *_SECONDS),
+    "hvd_gang_stale_ranks": _gauge(
+        "Ranks whose snapshot could not be read in the latest "
+        "aggregation fold (missing/torn/old-epoch KV entry and "
+        "unreachable scrape fallback)."),
 }
 
 
@@ -322,6 +334,54 @@ class Registry:
                 lines.append(f"{name}_sum{suffix} {_fmt(h[-2])}")
                 lines.append(f"{name}_count{suffix} {h[-1]}")
         return "\n".join(lines) + "\n"
+
+
+# -- quantile math (shared by aggregate.py, serving /stats, bench.py) ----
+
+
+def quantile(samples, q: float) -> float:
+    """The ``q``-quantile (``0 <= q <= 1``) of raw samples with linear
+    interpolation between order statistics — numerically identical to
+    ``np.percentile(samples, 100 * q)`` so bench.py's gated numbers do
+    not move when it switches over.  Empty input -> 0.0."""
+    xs = sorted(float(x) for x in samples)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return xs[0]
+    h = (len(xs) - 1) * q
+    lo = int(h)
+    if lo >= len(xs) - 1:
+        return xs[-1]
+    return xs[lo] + (h - lo) * (xs[lo + 1] - xs[lo])
+
+
+def histogram_quantile(hist: dict, q: float) -> float:
+    """The ``q``-quantile (``0 <= q <= 1``) of a snapshot-form histogram
+    (``{"buckets": {bound: n, ..., "+Inf": n}, "sum": ..., "count": ...}``).
+
+    Exact for the fixed log2 buckets this registry uses, in the sense
+    that it returns the smallest bucket upper bound whose cumulative
+    count reaches ``q * count`` — every observation in a bucket is ``<=``
+    that bound, so the reported value is a true upper bound on the real
+    quantile with at most one bucket (2x) of slack, and merged per-rank
+    histograms give the same answer as one gang-wide histogram would.
+    Mass landing in ``+Inf`` reports the last finite bound (the result
+    must stay JSON-serializable).  Empty histogram -> 0.0."""
+    buckets = hist.get("buckets", {})
+    bounds = sorted((float(b), int(n)) for b, n in buckets.items()
+                    if b not in ("+Inf", "inf"))
+    total = sum(n for _, n in bounds)
+    total += int(buckets.get("+Inf", buckets.get("inf", 0)))
+    if total <= 0 or not bounds:
+        return 0.0
+    target = q * float(total)
+    cum = 0
+    for b, n in bounds:
+        cum += n
+        if cum >= target and cum > 0:
+            return b
+    return bounds[-1][0]
 
 
 def _fmt(v) -> str:

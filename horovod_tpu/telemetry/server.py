@@ -86,6 +86,29 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.dumps(doc).encode("utf-8")
             self._send(200, body, "application/json")
             return
+        if self.path in ("/gang/metrics", "/gang/metrics.json",
+                         "/gang/health"):
+            # Gang-wide view: the live aggregator's latest fold (rank 0
+            # only — other ranks run no aggregator and answer 404).
+            import json
+
+            from horovod_tpu.telemetry import aggregate as _agg
+
+            agg = _agg.get()
+            if agg is None:
+                self._send(404, b'{"error": "no gang aggregator"}',
+                           "application/json")
+                return
+            if self.path == "/gang/metrics":
+                self._send(200, agg.render().encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif self.path == "/gang/metrics.json":
+                self._send(200, json.dumps(agg.view()).encode("utf-8"),
+                           "application/json")
+            else:
+                self._send(200, json.dumps(agg.health()).encode("utf-8"),
+                           "application/json")
+            return
         self._send(404, b"", "text/plain")
 
 

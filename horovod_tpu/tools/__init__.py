@@ -1,0 +1,13 @@
+"""Installable operator CLIs (``[project.scripts]`` in pyproject.toml).
+
+- ``hvd-top`` (hvd_top.py): live terminal dashboard over the gang
+  aggregator's ``/gang/metrics.json`` view.
+- ``hvd-trace`` (hvd_trace.py): merge/analyze/diff gang-wide span
+  traces.
+- ``hvd-postmortem`` (hvd_postmortem.py): gang-correlated verdict over
+  flight-recorder dumps.
+
+The repo-root ``tools/`` directory keeps thin shims for the historical
+``python tools/<name>.py`` invocations (and for the lints that live
+there, which are dev-only and not installed).
+"""

@@ -139,6 +139,27 @@ METRICS_PORT = "HVD_METRICS_PORT"
 METRICS_FILE = "HVD_METRICS_FILE"
 METRICS_INTERVAL = "HVD_METRICS_INTERVAL"
 STRAGGLER_WARN_MS = "HVD_STRAGGLER_WARN_MS"
+# Gang-wide aggregation & streaming anomaly alerts (telemetry/aggregate.py;
+# docs/metrics.md "Gang-wide aggregation & alerts").  AGG_INTERVAL paces
+# rank 0's fold of every rank's snapshot into the single gang view
+# served at /gang/metrics*.  The HVD_ALERT_* knobs tune the EWMA rules
+# the anomaly engine evaluates each fold: EWMA_ALPHA is the trailing-
+# baseline smoothing factor, WARMUP the folds observed before any rule
+# may fire, COLLAPSE_FRAC the gang-throughput fraction of baseline below
+# which throughput_collapse fires, SKEW_FACTOR/SKEW_FLOOR_MS the
+# straggler-skew growth multiple and absolute floor, QUEUE_FACTOR /
+# RETRY_FACTOR the growth multiples for admission-queue depth and
+# ladder/KV retry rate, and SERVE_P99_MS the serve-SLO p99 ceiling in
+# milliseconds (0 = rule off).
+AGG_INTERVAL = "HVD_AGG_INTERVAL"
+ALERT_EWMA_ALPHA = "HVD_ALERT_EWMA_ALPHA"
+ALERT_WARMUP = "HVD_ALERT_WARMUP"
+ALERT_COLLAPSE_FRAC = "HVD_ALERT_COLLAPSE_FRAC"
+ALERT_SKEW_FACTOR = "HVD_ALERT_SKEW_FACTOR"
+ALERT_SKEW_FLOOR_MS = "HVD_ALERT_SKEW_FLOOR_MS"
+ALERT_QUEUE_FACTOR = "HVD_ALERT_QUEUE_FACTOR"
+ALERT_RETRY_FACTOR = "HVD_ALERT_RETRY_FACTOR"
+ALERT_SERVE_P99_MS = "HVD_ALERT_SERVE_P99_MS"
 # Gang-wide distributed tracing (telemetry/trace.py; docs/timeline.md
 # "Gang-wide tracing").  TRACE=1 makes EVERY rank stream structured
 # spans (negotiate/pack/hop/unpack/callback, serving and elastic steps)
@@ -337,6 +358,59 @@ def blackbox_events() -> int:
 def blackbox_dir() -> str:
     """Directory the per-rank ``blackbox_rank<r>.json`` dumps land in."""
     return get_str(BLACKBOX_DIR, "hvd_blackbox") or "hvd_blackbox"
+
+
+def agg_interval_s() -> float:
+    """Gang-aggregation fold cadence on rank 0; floor 0.05 s."""
+    return max(0.05, get_float(AGG_INTERVAL, 2.0))
+
+
+def alert_ewma_alpha() -> float:
+    """EWMA smoothing factor for the trailing baselines, clamped to
+    (0, 1].  Higher = baseline chases recent folds faster."""
+    return min(1.0, max(0.01, get_float(ALERT_EWMA_ALPHA, 0.3)))
+
+
+def alert_warmup() -> int:
+    """Folds a rule's baseline must observe before it may fire; floor 1
+    (a rule with no baseline at all has nothing to compare against)."""
+    return max(1, get_int(ALERT_WARMUP, 3))
+
+
+def alert_collapse_frac() -> float:
+    """throughput_collapse threshold: fire when the gang collective rate
+    drops below this fraction of its EWMA baseline; clamped to (0, 1)."""
+    return min(0.99, max(0.01, get_float(ALERT_COLLAPSE_FRAC, 0.5)))
+
+
+def alert_skew_factor() -> float:
+    """straggler_skew growth multiple vs a rank's EWMA baseline;
+    floor 1.0."""
+    return max(1.0, get_float(ALERT_SKEW_FACTOR, 3.0))
+
+
+def alert_skew_floor_ms() -> float:
+    """Absolute straggler-skew floor in milliseconds — growth below it
+    never fires (small-number noise)."""
+    return max(0.0, get_float(ALERT_SKEW_FLOOR_MS, 50.0))
+
+
+def alert_queue_factor() -> float:
+    """queue_growth multiple vs the EWMA queue-depth baseline;
+    floor 1.0."""
+    return max(1.0, get_float(ALERT_QUEUE_FACTOR, 3.0))
+
+
+def alert_retry_factor() -> float:
+    """retry_spike multiple vs the EWMA per-fold retry-count baseline;
+    floor 1.0."""
+    return max(1.0, get_float(ALERT_RETRY_FACTOR, 3.0))
+
+
+def alert_serve_p99_ms() -> float:
+    """serve_p99_breach ceiling for the interval's gang-wide decode-step
+    p99, in milliseconds; 0 (default) disables the rule."""
+    return max(0.0, get_float(ALERT_SERVE_P99_MS, 0.0))
 
 
 def send_wait_cap_s() -> float:

@@ -69,8 +69,12 @@ TRANSPORT_FAILOVER = "TRANSPORT_FAILOVER"
 SUBCOORD_REPARENT = "SUBCOORD_REPARENT"
 PARTITION_MINORITY = "PARTITION_MINORITY"
 
-# Telemetry records (horovod_tpu.telemetry; docs/metrics.md).
+# Telemetry records (horovod_tpu.telemetry; docs/metrics.md).  ALERT =
+# the gang aggregator's streaming anomaly engine tripped a rule
+# (telemetry/aggregate.py; args name the rule, the implicated rank, the
+# observed value and its EWMA baseline).
 STRAGGLER = "STRAGGLER"
+ALERT = "ALERT"
 
 # Writer-thread flush cadence: events are buffered and flushed when the
 # queue runs dry or every _FLUSH_EVERY events, whichever comes first —

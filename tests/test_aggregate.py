@@ -1,0 +1,459 @@
+"""Gang-wide telemetry aggregation, anomaly alerts, and hvd_top.
+
+Covers telemetry/aggregate.py end to end without spawning processes: a
+4-rank gang is faked with four standalone ``Registry`` instances whose
+snapshots are published to a fake KV, and the coordinator-side
+``GangAggregator`` is driven fold-by-fold with explicit timestamps —
+exact merged quantiles against a one-big-registry oracle, the EWMA
+anomaly rules naming a chaos-slowed rank, the ``/gang/*`` endpoints on
+a real MetricsServer, ``hvd_top --once --json`` parity, scrape fault
+tolerance (``agg.scrape`` chaos site included), and the zero-cost pins
+for ``HVD_METRICS`` unset.
+"""
+
+import gc
+import json
+import threading
+import time
+import tracemalloc
+import urllib.error
+import urllib.request
+
+import pytest
+
+import horovod_tpu.telemetry as tmx
+from horovod_tpu import basics
+from horovod_tpu.common import fault_injection as fi
+from horovod_tpu.telemetry import aggregate as agg_mod
+from horovod_tpu.telemetry import registry as reg_mod
+from horovod_tpu.telemetry import server as server_mod
+from horovod_tpu.tools import hvd_top
+from horovod_tpu.utils import timeline as timeline_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    fi.clear()
+    tmx.reset()
+    agg_mod.configure(None)
+    yield
+    fi.clear()
+    tmx.reset()
+    agg_mod.configure(None)
+
+
+class _FakeKV:
+    def __init__(self):
+        self.data = {}
+        self.puts = []
+
+    def get(self, key):
+        return self.data.get(key)
+
+    def put(self, key, value):
+        self.data[key] = value
+        self.puts.append(key)
+
+
+def _publish(kv, rank, reg, epoch=0):
+    kv.data[f"metrics/{rank}"] = json.dumps(
+        {"rank": rank, "seq": 0, "epoch": epoch, **reg.snapshot()})
+
+
+def _mk_regs(n=4):
+    return {r: reg_mod.Registry() for r in range(n)}
+
+
+def _healthy_interval(regs, collectives=100):
+    for r, reg in regs.items():
+        reg.inc_counter("hvd_collectives_total", collectives,
+                        labels=("allreduce", "f32"))
+        for i in range(20):
+            reg.observe("hvd_collective_latency_seconds",
+                        0.001 * (1 + (i + r) % 5),
+                        labels=("allreduce", "f32"))
+        reg.set_gauge("hvd_queue_depth", 1)
+    # Modest, steady skew attributed to rank 2 (under the alert floor).
+    for _ in range(3):
+        regs[0].observe("hvd_straggler_skew_seconds", 0.005,
+                        labels=("2",))
+
+
+def _slow_interval(regs, slow_rank=2):
+    for r, reg in regs.items():
+        if r != slow_rank:
+            reg.inc_counter("hvd_collectives_total", 50,
+                            labels=("allreduce", "f32"))
+    for _ in range(3):
+        regs[0].observe("hvd_straggler_skew_seconds", 0.2,
+                        labels=(str(slow_rank),))
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return resp.read()
+
+
+# -- pure fold math -------------------------------------------------------
+
+
+def test_quantile_matches_numpy_percentile():
+    np = pytest.importorskip("numpy")
+    xs = [((i * 37) % 101) / 7.0 for i in range(53)]
+    for q in (0.0, 0.25, 0.5, 0.9, 0.99, 1.0):
+        assert reg_mod.quantile(xs, q) == pytest.approx(
+            float(np.percentile(xs, q * 100)), abs=1e-12)
+    assert reg_mod.quantile([], 0.5) == 0.0
+    assert reg_mod.quantile([3.0], 0.99) == 3.0
+
+
+def test_histogram_quantile_bucket_semantics():
+    reg = reg_mod.Registry()
+    for v in (0.001, 0.001, 0.004, 0.1):
+        reg.observe("hvd_cycle_duration_seconds", v)
+    h = reg.snapshot()["histograms"]["hvd_cycle_duration_seconds"]
+    # Smallest bucket bound whose cumulative count reaches q*count.
+    p50 = reg_mod.histogram_quantile(h, 0.5)
+    assert 0.001 <= p50 <= 0.002
+    p99 = reg_mod.histogram_quantile(h, 0.99)
+    assert p99 >= 0.1
+    assert reg_mod.histogram_quantile({"buckets": {}, "count": 0}, 0.5) \
+        == 0.0
+
+
+def test_fold_merges_histograms_exactly_vs_oracle():
+    regs = _mk_regs(4)
+    oracle = reg_mod.Registry()
+    key = 'hvd_collective_latency_seconds{op="allreduce",dtype="f32"}'
+    for r, reg in regs.items():
+        for i in range(100 + 40 * r):
+            v = 0.0007 * (1 + ((i * 7 + r) % 13))
+            reg.observe("hvd_collective_latency_seconds", v,
+                        labels=("allreduce", "f32"))
+            oracle.observe("hvd_collective_latency_seconds", v,
+                           labels=("allreduce", "f32"))
+        reg.inc_counter("hvd_cache_hits_total", 10 + r)
+        reg.set_gauge("hvd_queue_depth", 2 * r)
+    view = agg_mod.fold({r: reg.snapshot() for r, reg in regs.items()})
+    merged = view["histograms"][key]
+    oh = oracle.snapshot()["histograms"][key]
+    assert merged["buckets"] == oh["buckets"]
+    assert merged["count"] == oh["count"]
+    assert merged["sum"] == pytest.approx(oh["sum"])
+    for q in (0.5, 0.9, 0.99):
+        assert reg_mod.histogram_quantile(merged, q) == \
+            reg_mod.histogram_quantile(oh, q)
+    assert merged["p50"] == reg_mod.histogram_quantile(oh, 0.50)
+    assert merged["p99"] == reg_mod.histogram_quantile(oh, 0.99)
+    # Counters summed; gauges carry per-rank values + rollups.
+    assert view["counters"]["hvd_cache_hits_total"] == 10 + 11 + 12 + 13
+    g = view["gauges"]["hvd_queue_depth"]
+    assert g["per_rank"] == {"0": 0.0, "1": 2.0, "2": 4.0, "3": 6.0}
+    assert g["min"] == 0.0 and g["max"] == 6.0 and g["median"] == 3.0
+
+
+def test_render_prometheus_cumulative_buckets():
+    reg = reg_mod.Registry()
+    reg.observe("hvd_cycle_duration_seconds", 0.001)
+    reg.observe("hvd_cycle_duration_seconds", 100.0)  # +Inf bucket
+    reg.inc_counter("hvd_cycles_total", 3)
+    view = agg_mod.fold({0: reg.snapshot(), 1: reg.snapshot()})
+    text = agg_mod.render_prometheus(view)
+    assert "hvd_cycles_total 6" in text
+    assert 'hvd_cycle_duration_seconds_bucket{le="+Inf"} 4' in text
+    assert "hvd_cycle_duration_seconds_count 4" in text
+    assert "# TYPE hvd_cycle_duration_seconds histogram" in text
+
+
+# -- the 4-rank in-process gang (acceptance scenario) ---------------------
+
+
+def test_gang_view_alerts_endpoints_and_hvd_top(monkeypatch, tmp_path,
+                                                capsys):
+    monkeypatch.setenv("HVD_ALERT_WARMUP", "2")
+    monkeypatch.setenv("HVD_ALERT_COLLAPSE_FRAC", "0.8")
+    monkeypatch.setenv("HVD_ALERT_SKEW_FACTOR", "3")
+    monkeypatch.setenv("HVD_ALERT_SKEW_FLOOR_MS", "50")
+
+    # ALERT timeline records land on the engine timeline; fake a runtime
+    # that owns one (tests run without an engine).
+    tl = timeline_mod.Timeline()
+    tl_path = tmp_path / "timeline.json"
+    tl.initialize(str(tl_path))
+
+    class _Rt:
+        timeline = tl
+
+    monkeypatch.setattr(basics, "_runtime", _Rt())
+    reg_mod.configure(True)  # rank 0's own registry: hvd_alerts_total
+
+    regs = _mk_regs(4)
+    kv = _FakeKV()
+    agg = agg_mod.GangAggregator(4, kv=kv, interval_s=999.0, epoch=0)
+
+    # Three healthy folds build the EWMA baselines (warmup=2).
+    now = 100.0
+    for _ in range(3):
+        _healthy_interval(regs)
+        for r, reg in regs.items():
+            _publish(kv, r, reg)
+        agg.poll_once(now=now)
+        now += 1.0
+    assert agg.view()["alerts"] == []
+
+    # Rank 2 goes dark-slow: zero collectives, 200 ms skew.  Both rules
+    # must fire within 2 folds, naming rank 2.
+    fired_at = None
+    for fold_i in range(2):
+        _slow_interval(regs, slow_rank=2)
+        for r, reg in regs.items():
+            _publish(kv, r, reg)
+        view = agg.poll_once(now=now)
+        now += 1.0
+        rules = {a["rule"] for a in view["alerts"]}
+        if {"throughput_collapse", "straggler_skew"} <= rules:
+            fired_at = fold_i
+            break
+    assert fired_at is not None, "rules did not fire within 2 folds"
+    by_rule = {a["rule"]: a for a in view["alerts"]}
+    assert by_rule["throughput_collapse"]["rank"] == 2
+    assert by_rule["straggler_skew"]["rank"] == 2
+
+    # Merged quantiles in the served view equal the per-rank oracle.
+    key = 'hvd_collective_latency_seconds{op="allreduce",dtype="f32"}'
+    oracle = agg_mod.merge_histograms(
+        [regs[r].snapshot()["histograms"][key] for r in range(4)])
+    assert view["histograms"][key]["buckets"] == oracle["buckets"]
+    assert view["histograms"][key]["p50"] == \
+        reg_mod.histogram_quantile(oracle, 0.50)
+    assert view["histograms"][key]["p99"] == \
+        reg_mod.histogram_quantile(oracle, 0.99)
+
+    # hvd_alerts_total{rule} bumped once per rising edge.
+    counters = reg_mod.snapshot()["counters"]
+    assert counters['hvd_alerts_total{rule="throughput_collapse"}'] == 1
+    assert counters['hvd_alerts_total{rule="straggler_skew"}'] == 1
+
+    # ALERT timeline records carry the verdict.
+    tl.shutdown()
+    events = json.loads(tl_path.read_text())
+    alerts = [ev for ev in events
+              if isinstance(ev, dict)
+              and ev.get("name") == timeline_mod.ALERT]
+    assert {ev["args"]["rule"] for ev in alerts} >= {
+        "throughput_collapse", "straggler_skew"}
+    assert all(ev["args"]["rank"] == 2 for ev in alerts)
+
+    # The view is mirrored into the KV for the fleet router.
+    assert json.loads(kv.data["gang/metrics"])["seq"] == view["seq"]
+
+    # Per-rank dashboard rows name the slow rank's alerts.
+    rows = {row["rank"]: row for row in view["per_rank"]}
+    assert rows[2]["step_rate"] == 0.0
+    assert set(rows[2]["alerts"]) >= {"throughput_collapse",
+                                      "straggler_skew"}
+    assert rows[0]["step_rate"] > 0
+
+    # Serve it: /gang/metrics.json equals the aggregator's view, the
+    # Prometheus form renders, /gang/health says alerting.
+    agg_mod.configure(agg)
+    srv = server_mod.MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        served = json.loads(_get(port, "/gang/metrics.json"))
+        assert served == json.loads(json.dumps(view))
+        text = _get(port, "/gang/metrics").decode()
+        assert "hvd_collectives_total" in text
+        health = json.loads(_get(port, "/gang/health"))
+        assert health["status"] == "alerting"
+        assert health["stale_ranks"] == []
+
+        # hvd_top --once --json returns the same document.
+        rc = hvd_top.main(["--addr", f"127.0.0.1:{port}",
+                           "--once", "--json"])
+        assert rc == 0
+        top_view = json.loads(capsys.readouterr().out)
+        assert top_view == served
+
+        # And the human rendering names the alerts on rank 2's row.
+        body = hvd_top.render(served)
+        assert "throughput_collapse" in body
+        assert "ALERT" in body
+    finally:
+        srv.stop()
+
+
+def test_gang_endpoints_404_without_aggregator():
+    reg_mod.configure(True)
+    srv = server_mod.MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(port, "/gang/metrics.json")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+# -- fault tolerance: stale ranks, never an exception ---------------------
+
+
+def test_missing_torn_and_stale_epoch_records_degrade():
+    regs = _mk_regs(4)
+    _healthy_interval(regs)
+    kv = _FakeKV()
+    _publish(kv, 0, regs[0], epoch=1)
+    _publish(kv, 1, regs[1], epoch=0)   # old epoch -> stale
+    kv.data["metrics/2"] = '{"rank": 2, "coun'  # torn write
+    # rank 3: no entry at all, no scrape address
+    agg = agg_mod.GangAggregator(4, kv=kv, interval_s=999.0, epoch=1)
+    view = agg.poll_once(now=1.0)
+    assert view["stale_ranks"] == [1, 2, 3]
+    assert view["ranks"] == [0]
+    assert view["counters"]  # partial view still folded
+    rows = {row["rank"]: row for row in view["per_rank"]}
+    assert rows[3]["stale"] is True
+    assert agg.health()["status"] == "degraded"
+
+
+def test_dead_rank_scrape_fallback_unreachable():
+    regs = _mk_regs(2)
+    _healthy_interval(regs)
+    kv = _FakeKV()
+    _publish(kv, 0, regs[0])
+    # Rank 1's KV entry is gone and its advertised scrape address is a
+    # dead port: the fold must degrade within the scrape timeout, not
+    # raise or hang.
+    agg = agg_mod.GangAggregator(
+        2, kv=kv, scrape_addrs={1: "127.0.0.1:9"}, interval_s=999.0)
+    t0 = time.monotonic()
+    view = agg.poll_once(now=1.0)
+    assert time.monotonic() - t0 < 10
+    assert view["stale_ranks"] == [1]
+
+
+def test_scrape_fallback_serves_missing_kv_entry():
+    regs = _mk_regs(2)
+    _healthy_interval(regs)
+    kv = _FakeKV()
+    _publish(kv, 0, regs[0])
+    # Rank 1 never published to the KV, but its debug server is alive:
+    # the aggregator scrapes /metrics.json directly.
+    reg_mod.configure(True)
+    srv = server_mod.MetricsServer(host="127.0.0.1", port=0)
+    port = srv.start()
+    try:
+        # The module registry backs the server; seed it so the scrape
+        # has content.
+        reg_mod.inc_counter("hvd_cycles_total", 7)
+        agg = agg_mod.GangAggregator(
+            2, kv=kv, scrape_addrs={1: f"127.0.0.1:{port}"},
+            interval_s=999.0)
+        view = agg.poll_once(now=1.0)
+        assert view["stale_ranks"] == []
+        assert view["counters"]["hvd_cycles_total"] == 7
+    finally:
+        srv.stop()
+
+
+def test_agg_scrape_chaos_site_degrades_one_rank():
+    regs = _mk_regs(4)
+    _healthy_interval(regs)
+    kv = _FakeKV()
+    for r, reg in regs.items():
+        _publish(kv, r, reg)
+    fi.configure({"faults": [
+        {"site": "agg.scrape", "kind": "error", "match": "2"}]})
+    agg = agg_mod.GangAggregator(4, kv=kv, interval_s=999.0)
+    view = agg.poll_once(now=1.0)
+    assert view["stale_ranks"] == [2]
+    assert view["ranks"] == [0, 1, 3]
+    fi.clear()
+    for r, reg in regs.items():
+        _publish(kv, r, reg)
+    assert agg.poll_once(now=2.0)["stale_ranks"] == []
+
+
+def test_fold_survives_kv_get_raising():
+    class _BoomKV(_FakeKV):
+        def get(self, key):
+            raise ConnectionError("kv down")
+
+    agg = agg_mod.GangAggregator(3, kv=_BoomKV(), interval_s=999.0)
+    view = agg.poll_once(now=1.0)
+    assert view["stale_ranks"] == [0, 1, 2]
+
+
+# -- zero-cost pins when HVD_METRICS is unset -----------------------------
+
+
+def test_aggregator_zero_cost_when_disabled(monkeypatch):
+    for var in ("HVD_METRICS", "HVD_METRICS_PORT", "HVD_METRICS_FILE"):
+        monkeypatch.delenv(var, raising=False)
+
+    class _TimeProxy:
+        def __init__(self, real):
+            self._real = real
+            self.calls = 0
+
+        def monotonic(self):
+            self.calls += 1
+            return self._real.monotonic()
+
+        def __getattr__(self, name):
+            return getattr(self._real, name)
+
+    proxy = _TimeProxy(time)
+    monkeypatch.setattr(agg_mod, "time", proxy)
+    before_threads = set(threading.enumerate())
+
+    assert tmx.init_from_env(0, size=4) is False
+    assert agg_mod.get() is None
+    assert set(threading.enumerate()) == before_threads
+    assert proxy.calls == 0, "disabled telemetry read the clock"
+
+    # Steady state: the accessor the server route takes is one global
+    # load — no allocations (the registry-hook pin, applied here).
+    agg_mod.get()
+    gc.collect()
+    tracemalloc.start()
+    before = tracemalloc.get_traced_memory()[0]
+    for _ in range(10000):
+        agg_mod.get()
+    after = tracemalloc.get_traced_memory()[0]
+    tracemalloc.stop()
+    assert after - before < 512
+    assert proxy.calls == 0
+
+
+def test_init_from_env_starts_aggregator_on_rank0_only(monkeypatch):
+    monkeypatch.setenv("HVD_METRICS", "1")
+    # No rendezvous KV in the env -> no aggregator (it would have no
+    # snapshot source), and never on nonzero ranks.
+    assert tmx.init_from_env(1, size=4) is True
+    assert agg_mod.get() is None
+    tmx.reset()
+    assert tmx.init_from_env(0, size=1) is True
+    assert agg_mod.get() is None
+
+
+def test_stop_tears_down_aggregator(monkeypatch):
+    regs = _mk_regs(2)
+    _healthy_interval(regs)
+    kv = _FakeKV()
+    for r, reg in regs.items():
+        _publish(kv, r, reg)
+    agg = agg_mod.GangAggregator(2, kv=kv, interval_s=0.05)
+    agg_mod.configure(agg)
+    agg.start()
+    deadline = time.monotonic() + 5
+    while agg.view() == {} and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert agg.view() != {}
+    assert any(t.name == "hvd-gang-agg" for t in threading.enumerate())
+    agg_mod.stop()
+    assert agg_mod.get() is None
+    time.sleep(0.05)
+    assert not any(t.name == "hvd-gang-agg"
+                   for t in threading.enumerate())

@@ -46,6 +46,21 @@ def test_every_registered_metric_is_documented():
         "docs/metrics.md")
 
 
+def test_every_alert_rule_is_documented():
+    rules = check_metric_docs.alert_rules()
+    assert "throughput_collapse" in rules
+    assert "straggler_skew" in rules
+    undoc = check_metric_docs.undocumented_alert_rules()
+    assert not undoc, (
+        f"undocumented alert rules: {undoc} — add them to the rule "
+        "table in docs/metrics.md (Gang-wide aggregation & alerts)")
+
+
+def test_missing_doc_file_reports_every_alert_rule(tmp_path):
+    undoc = check_metric_docs.undocumented_alert_rules(tmp_path / "n.md")
+    assert undoc == sorted(check_metric_docs.alert_rules())
+
+
 def test_undeclared_scan_on_synthetic_tree(tmp_path):
     pkg = tmp_path / "pkg"
     pkg.mkdir()

@@ -130,8 +130,7 @@ def check(tolerance: float = 0.2, root: Path = REPO_ROOT) -> List[str]:
     # Latency gate: shared percentile metrics must not RISE past the
     # tolerance (higher = worse, the mirror image of throughput).
     lat = {k for k in set(old) & set(new)
-           if k.endswith(("_p99_ms", "_p50_ms"))
-           or k == "coordination_cycle_p50_us"}
+           if k.endswith(("_p99_ms", "_p50_ms", "_p50_us", "_p99_us"))}
     for k in sorted(lat):
         if old[k] <= 0:
             continue

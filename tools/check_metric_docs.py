@@ -92,6 +92,26 @@ def undocumented_metrics(doc_file: Path = DOC_FILE) -> list:
             if not re.search(rf"\b{re.escape(m)}\b", text)]
 
 
+def alert_rules() -> tuple:
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        from horovod_tpu.telemetry import aggregate as agg
+    finally:
+        sys.path.pop(0)
+    return tuple(agg.ALERT_RULES)
+
+
+def undocumented_alert_rules(doc_file: Path = DOC_FILE) -> list:
+    """Anomaly-engine rule names (telemetry.aggregate.ALERT_RULES)
+    missing from the docs/metrics.md rule table — the same contract as
+    the metric table, for the alert surface."""
+    if not doc_file.is_file():
+        return sorted(alert_rules())
+    text = doc_file.read_text(encoding="utf-8")
+    return [r for r in sorted(alert_rules())
+            if not re.search(rf"\b{re.escape(r)}\b", text)]
+
+
 def main() -> int:
     bad = False
     undecl = undeclared_metrics()
@@ -109,13 +129,21 @@ def main() -> int:
               "table:", file=sys.stderr)
         for m in undoc:
             print(f"  {m!r}", file=sys.stderr)
+    undoc_rules = undocumented_alert_rules()
+    if undoc_rules:
+        bad = True
+        print("anomaly-engine alert rules missing from the "
+              "docs/metrics.md rule table:", file=sys.stderr)
+        for r in undoc_rules:
+            print(f"  {r!r}", file=sys.stderr)
     if bad:
         print("declare each metric in KNOWN_METRICS "
               "(horovod_tpu/telemetry/registry.py) and document it in "
               "the table in docs/metrics.md.", file=sys.stderr)
         return 1
     print(f"ok: {len(registry())} metrics registered and documented; "
-          f"{len(used_literals())} literal call sites in the package")
+          f"{len(used_literals())} literal call sites in the package; "
+          f"{len(alert_rules())} alert rules documented")
     return 0
 
 

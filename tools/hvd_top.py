@@ -1,0 +1,15 @@
+#!/usr/bin/env python3
+"""Shim: the implementation lives in horovod_tpu/tools/hvd_top.py so it
+installs with the package (``hvd-top`` console script)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.tools import hvd_top as _impl  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(_impl.main())
+else:
+    sys.modules[__name__] = _impl
